@@ -1,0 +1,7 @@
+"""se3_transformer_tpu — a TPU-native (JAX / XLA / Pallas / pjit) SE(3)-
+equivariant transformer framework with the full capability surface of
+lucidrains/se3-transformer-pytorch, redesigned TPU-first.
+"""
+__version__ = '0.1.0'
+
+from .basis import get_basis, basis_transformation_Q_J

@@ -1,0 +1,214 @@
+"""Multi-degree SE(3)-equivariant attention.
+
+TPU-native rework of reference AttentionSE3 (:387-519),
+OneHeadedKVAttentionSE3 (:522-654) and AttentionBlockSE3 (:656-683). Both
+attention flavours share one implementation parameterized by `kv_heads`
+(either `heads`, or 1 for the Shazeer multi-query variant) — the logits /
+output einsums are the only difference.
+
+KV slot order (left of the neighbor axis, matching reference concat order
+:469-506): [global, null, self, neighbors]; the neighbor mask is left-padded
+with True over the prepended slots (:510-513). Rotary embeddings are applied
+to degree-0 q/k/v *before* null/global slots are prepended (:488-494).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..utils.helpers import batched_index_select, to_order
+from .conv import ConvSE3, EdgeInfo
+from .core import LinearSE3, NormSE3, residual_se3
+from .fiber import Fiber
+from .rotary import apply_rotary_pos_emb
+
+Features = Dict[str, jnp.ndarray]
+
+
+class AttentionSE3(nn.Module):
+    fiber: Fiber
+    dim_head: int = 64
+    heads: int = 8
+    kv_heads: Optional[int] = None  # None -> heads; 1 -> multi-query
+    attend_self: bool = False
+    edge_dim: Optional[int] = None
+    fourier_encode_dist: bool = False
+    rel_dist_num_fourier_features: int = 4
+    use_null_kv: bool = False
+    global_feats_dim: Optional[int] = None
+    linear_proj_keys: bool = False
+    tie_key_values: bool = False
+
+    @nn.compact
+    def __call__(self, features: Features, edge_info: EdgeInfo,
+                 rel_dist: jnp.ndarray, basis: Dict[str, jnp.ndarray],
+                 global_feats: Optional[Features] = None,
+                 pos_emb: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+                 mask: Optional[jnp.ndarray] = None) -> Features:
+        h = self.heads
+        kv_h = self.kv_heads if self.kv_heads is not None else self.heads
+        one_headed = kv_h == 1
+        neighbor_indices, neighbor_mask, edges = edge_info
+
+        hidden_fiber = self.fiber.to(self.dim_head * h)
+        kv_fiber = self.fiber.to(self.dim_head * kv_h)
+        project_out = not (h == 1 and len(self.fiber.dims) == 1
+                           and self.dim_head == self.fiber.dims[0])
+
+        assert not (self.linear_proj_keys and self.tie_key_values), \
+            'cannot do linear projection of keys and tied key/values together'
+
+        conv_kwargs = dict(
+            pool=False, self_interaction=False,
+            edge_dim=self.edge_dim or 0,
+            fourier_encode_dist=self.fourier_encode_dist,
+            num_fourier_features=self.rel_dist_num_fourier_features)
+
+        queries = LinearSE3(self.fiber, hidden_fiber, name='to_q')(features)
+        values = ConvSE3(self.fiber, kv_fiber, name='to_v', **conv_kwargs)(
+            features, edge_info, rel_dist, basis)
+
+        if self.linear_proj_keys:
+            keys = LinearSE3(self.fiber, kv_fiber, name='to_k')(features)
+            keys = {d: batched_index_select(v, neighbor_indices, axis=1)
+                    for d, v in keys.items()}
+        elif self.tie_key_values:
+            keys = values
+        else:
+            keys = ConvSE3(self.fiber, kv_fiber, name='to_k', **conv_kwargs)(
+                features, edge_info, rel_dist, basis)
+
+        if self.attend_self:
+            self_keys = LinearSE3(self.fiber, kv_fiber,
+                                  name='to_self_k')(features)
+            self_values = LinearSE3(self.fiber, kv_fiber,
+                                    name='to_self_v')(features)
+
+        if global_feats is not None:
+            g_in = Fiber.create(1, self.global_feats_dim)
+            g_out = Fiber.create(1, self.dim_head * kv_h)
+            global_keys = LinearSE3(g_in, g_out, name='to_global_k')(global_feats)
+            global_values = LinearSE3(g_in, g_out, name='to_global_v')(global_feats)
+
+        outputs = {}
+        for degree in features.keys():
+            m = to_order(int(degree))
+            q, k, v = queries[degree], keys[degree], values[degree]
+            b, n = q.shape[0], q.shape[1]
+
+            # split heads: q [b, h, n, d, m]; k/v [b, kv_h, n, j, d, m]
+            q = q.reshape(b, n, h, self.dim_head, m).transpose(0, 2, 1, 3, 4)
+            k, v = [t.reshape(b, n, t.shape[2], kv_h, self.dim_head, m)
+                    .transpose(0, 3, 1, 2, 4, 5) for t in (k, v)]
+
+            if self.attend_self:
+                s_k, s_v = self_keys[degree], self_values[degree]
+                s_k, s_v = [t.reshape(b, n, kv_h, self.dim_head, m)
+                            .transpose(0, 2, 1, 3, 4)[:, :, :, None]
+                            for t in (s_k, s_v)]
+                k = jnp.concatenate((s_k, k), axis=3)
+                v = jnp.concatenate((s_v, v), axis=3)
+
+            if pos_emb is not None and degree == '0':
+                query_pos_emb, key_pos_emb = pos_emb
+                q = apply_rotary_pos_emb(q, query_pos_emb[:, None, :, :])
+                k = apply_rotary_pos_emb(k, key_pos_emb[:, None])
+                v = apply_rotary_pos_emb(v, key_pos_emb[:, None])
+
+            if self.use_null_kv:
+                null_k = self.param(f'null_k{degree}', nn.initializers.zeros,
+                                    (kv_h, self.dim_head, m), q.dtype)
+                null_v = self.param(f'null_v{degree}', nn.initializers.zeros,
+                                    (kv_h, self.dim_head, m), q.dtype)
+                null_k, null_v = [
+                    jnp.broadcast_to(t[None, :, None, None],
+                                     (b, kv_h, n, 1, self.dim_head, m))
+                    for t in (null_k, null_v)]
+                k = jnp.concatenate((null_k, k), axis=3)
+                v = jnp.concatenate((null_v, v), axis=3)
+
+            if global_feats is not None and degree == '0':
+                g_k, g_v = global_keys['0'], global_values['0']
+                num_g = g_k.shape[1]
+                g_k, g_v = [t.reshape(b, num_g, kv_h, self.dim_head, m)
+                            .transpose(0, 2, 1, 3, 4)[:, :, None]
+                            for t in (g_k, g_v)]
+                g_k, g_v = [jnp.broadcast_to(
+                    t, (b, kv_h, n, num_g, self.dim_head, m))
+                    for t in (g_k, g_v)]
+                k = jnp.concatenate((g_k, k), axis=3)
+                v = jnp.concatenate((g_v, v), axis=3)
+
+            scale = self.dim_head ** -0.5
+            if one_headed:
+                sim = jnp.einsum('bhidm,bijdm->bhij', q, k[:, 0]) * scale
+            else:
+                sim = jnp.einsum('bhidm,bhijdm->bhij', q, k) * scale
+
+            if neighbor_mask is not None:
+                num_left_pad = sim.shape[-1] - neighbor_mask.shape[-1]
+                padded = jnp.pad(neighbor_mask,
+                                 ((0, 0), (0, 0), (num_left_pad, 0)),
+                                 constant_values=True)
+                sim = jnp.where(padded[:, None], sim,
+                                jnp.finfo(sim.dtype).min)
+
+            attn = nn.softmax(sim, axis=-1)
+            if one_headed:
+                out = jnp.einsum('bhij,bijdm->bhidm', attn, v[:, 0])
+            else:
+                out = jnp.einsum('bhij,bhijdm->bhidm', attn, v)
+            outputs[degree] = out.transpose(0, 2, 1, 3, 4).reshape(
+                b, n, h * self.dim_head, m)
+
+        if project_out:
+            outputs = LinearSE3(hidden_fiber, self.fiber,
+                                name='to_out')(outputs)
+        return outputs
+
+
+class OneHeadedKVAttentionSE3(AttentionSE3):
+    """Shazeer multi-query attention: one k/v head shared across all query
+    heads (reference :522-654)."""
+    kv_heads: Optional[int] = 1
+
+
+class AttentionBlockSE3(nn.Module):
+    """Prenorm + attention + residual (reference :656-683)."""
+    fiber: Fiber
+    dim_head: int = 24
+    heads: int = 8
+    attend_self: bool = False
+    edge_dim: Optional[int] = None
+    use_null_kv: bool = False
+    fourier_encode_dist: bool = False
+    rel_dist_num_fourier_features: int = 4
+    global_feats_dim: Optional[int] = None
+    linear_proj_keys: bool = False
+    tie_key_values: bool = False
+    one_headed_key_values: bool = False
+    norm_gated_scale: bool = False
+
+    @nn.compact
+    def __call__(self, features: Features, edge_info: EdgeInfo,
+                 rel_dist: jnp.ndarray, basis: Dict[str, jnp.ndarray],
+                 global_feats: Optional[Features] = None,
+                 pos_emb=None, mask=None) -> Features:
+        res = features
+        out = NormSE3(self.fiber, gated_scale=self.norm_gated_scale,
+                      name='prenorm')(features)
+        out = AttentionSE3(
+            self.fiber, heads=self.heads, dim_head=self.dim_head,
+            kv_heads=1 if self.one_headed_key_values else None,
+            attend_self=self.attend_self, edge_dim=self.edge_dim,
+            use_null_kv=self.use_null_kv,
+            fourier_encode_dist=self.fourier_encode_dist,
+            rel_dist_num_fourier_features=self.rel_dist_num_fourier_features,
+            global_feats_dim=self.global_feats_dim,
+            linear_proj_keys=self.linear_proj_keys,
+            tie_key_values=self.tie_key_values,
+            name='attn')(out, edge_info, rel_dist, basis, global_feats,
+                         pos_emb, mask)
+        return residual_se3(out, res)

@@ -1,0 +1,139 @@
+"""Tensor-field-network convolution (the compute hot spot).
+
+TPU-native rework of reference ConvSE3 / RadialFunc / PairwiseConv
+(/root/reference/se3_transformer_pytorch/se3_transformer_pytorch.py:154-343).
+
+Key departure from the reference: the reference materializes, per edge, the
+full unary kernel matrix [(2*do+1)*c_out, (2*di+1)*c_in] (PairwiseConv,
+:326-343) and then multiplies it with the gathered features, chunking the
+node axis into `splits` pieces to survive the peak memory (:222-254). Here
+the radial profile R, the angular basis B and the neighbor features x are
+contracted in a fused einsum chain
+
+    W[o, m_J..] = sum_i R[o, i, f] x[i, m_in]        (channel contraction)
+    y[o, m_out] = sum_{m_in, f} W[o, m_in, f] B[m_out, m_in, f]
+
+so the [oP x iQ] kernel never exists in HBM; XLA tiles the big channel
+contraction onto the MXU and fuses the small (2l+1)-sized contractions into
+it. No `splits` knob is needed — rematerialization (jax.checkpoint at the
+trunk level) plus XLA fusion replace eager chunking.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..utils.helpers import (
+    batched_index_select, fourier_encode, masked_mean, to_order,
+)
+from .core import LinearSE3, residual_se3
+from .fiber import Fiber
+
+Features = Dict[str, jnp.ndarray]
+# edge_info = (neighbor_indices [b,n,k], neighbor_mask [b,n,k] | None,
+#              edges [b,n,k,e] | None)
+EdgeInfo = Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]
+
+
+class RadialFunc(nn.Module):
+    """Per-edge radial profile MLP (reference :270-299).
+
+    edge scalar features [..., edge_dim+1] -> R [..., c_out, c_in, num_freq].
+    This is the dominant matmul of the model: [b*n*k, mid] @ [mid, o*i*f].
+    """
+    num_freq: int
+    in_dim: int
+    out_dim: int
+    edge_dim: int = 0
+    mid_dim: int = 128
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Dense(self.mid_dim)(x)
+        x = nn.LayerNorm()(x)
+        x = nn.gelu(x)
+        x = nn.Dense(self.mid_dim)(x)
+        x = nn.LayerNorm()(x)
+        x = nn.gelu(x)
+        x = nn.Dense(self.num_freq * self.in_dim * self.out_dim)(x)
+        return x.reshape(*x.shape[:-1], self.out_dim, self.in_dim,
+                         self.num_freq)
+
+
+def pairwise_conv_contract(R: jnp.ndarray, B: jnp.ndarray,
+                           x: jnp.ndarray) -> jnp.ndarray:
+    """Fused (radial x basis x features) contraction for one degree pair.
+
+    R: [b, n, k, c_out, c_in, f]   radial profiles
+    B: [b, n, k, 2*do+1, 2*di+1, f] angular basis
+    x: [b, n, k, c_in, 2*di+1]     gathered neighbor features
+    -> [b, n, k, c_out, 2*do+1]
+
+    Replaces reference PairwiseConv's explicit frequency loop + kernel
+    materialization (:336-343) and the kernel @ features einsum (:251).
+    """
+    # channel contraction first (big, MXU-friendly), small angular axes last
+    W = jnp.einsum('...oif,...iq->...oqf', R, x)
+    return jnp.einsum('...oqf,...pqf->...op', W, B)
+
+
+class ConvSE3(nn.Module):
+    """Graph TFN convolution over precomputed neighborhoods
+    (reference :154-268)."""
+    fiber_in: Fiber
+    fiber_out: Fiber
+    self_interaction: bool = True
+    pool: bool = True
+    edge_dim: int = 0
+    fourier_encode_dist: bool = False
+    num_fourier_features: int = 4
+
+    @nn.compact
+    def __call__(self, inp: Features, edge_info: EdgeInfo,
+                 rel_dist: jnp.ndarray, basis: Dict[str, jnp.ndarray]
+                 ) -> Features:
+        neighbor_indices, neighbor_masks, edges = edge_info
+
+        rel_dist_feats = rel_dist[..., None]  # [b, n, k, 1]
+        if self.fourier_encode_dist:
+            rel_dist_feats = fourier_encode(
+                rel_dist_feats, num_encodings=self.num_fourier_features)
+
+        edge_features = rel_dist_feats
+        if edges is not None:
+            edge_features = jnp.concatenate((rel_dist_feats, edges), axis=-1)
+
+        # gather neighbor features once per input degree
+        gathered = {}
+        for degree_in, _ in self.fiber_in:
+            key = str(degree_in)
+            gathered[key] = batched_index_select(
+                inp[key], neighbor_indices, axis=1)  # [b, n, k, c_in, 2di+1]
+
+        outputs = {}
+        for degree_out, m_out in self.fiber_out:
+            acc = None
+            for degree_in, m_in in self.fiber_in:
+                num_freq = to_order(min(degree_in, degree_out))
+                R = RadialFunc(
+                    num_freq, m_in, m_out,
+                    edge_dim=edge_features.shape[-1] - 1,
+                    name=f'radial_{degree_in}_{degree_out}')(edge_features)
+                B = basis[f'{degree_in},{degree_out}']
+                y = pairwise_conv_contract(R, B, gathered[str(degree_in)])
+                acc = y if acc is None else acc + y
+
+            if self.pool:
+                acc = masked_mean(acc, neighbor_masks, axis=2) \
+                    if neighbor_masks is not None else acc.mean(axis=2)
+            outputs[str(degree_out)] = acc
+
+        if self.self_interaction:
+            assert self.pool, 'must pool edges if followed with self interaction'
+            self_out = LinearSE3(self.fiber_in, self.fiber_out,
+                                 name='self_interact')(inp)
+            outputs = residual_se3(outputs, self_out)
+
+        return outputs

@@ -1,0 +1,10 @@
+from .spherical_harmonics import (
+    real_spherical_harmonics,
+    real_spherical_harmonics_all,
+    spherical_harmonics_angles,
+    angles_to_xyz,
+)
+from .wigner import (
+    rot, rot_z, rot_y, rot_to_euler, compose, irr_repr,
+    wigner_d_from_rotation, x_to_alpha_beta,
+)

@@ -1,0 +1,112 @@
+"""Real spherical harmonics, evaluated polynomially from Cartesian coordinates.
+
+TPU-native replacement for the reference's memoized associated-Legendre
+recursion over angles (/root/reference/se3_transformer_pytorch/
+spherical_harmonics.py:34-123). Instead of (theta, phi) trigonometry we
+evaluate the tesseral harmonics directly as polynomials in the unit-vector
+components (x, y, z):
+
+    Y_{l, m>0} = sqrt(2) K_{lm} Ptil_l^m(z) A_m(x, y)
+    Y_{l, 0}   =         K_{l0} Ptil_l^0(z)
+    Y_{l, m<0} = sqrt(2) K_{l|m|} Ptil_l^{|m|}(z) B_{|m|}(x, y)
+
+where A_m + i B_m = (x + i y)^m (computed by a 2-term recursion) and
+Ptil_l^m(z) = P_l^m(cos t)/sin^m t is the Condon-Shortley-free associated
+Legendre polynomial divided by sin^m, itself a polynomial in z obtained by
+the standard 3-term upward recursion. This formulation:
+
+  * has no atan2/arccos/pole singularities (fully differentiable, no NaNs),
+  * is a short static unroll over degrees (jit/XLA fuses it into the
+    surrounding basis computation — pure VPU element-wise work),
+  * is the single source of truth for basis conventions: the Wigner-D
+    matrices in so3.wigner are *derived from these functions*, so the
+    representation property Y(R x) = D(R) Y(x) holds by construction.
+
+With this convention Y_1 is ordered (y, z, x) up to a positive constant
+(m = -1, 0, 1), matching the common real-harmonics ordering.
+"""
+from __future__ import annotations
+
+import math
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@lru_cache(maxsize=None)
+def _norm_const(l: int, m: int) -> float:
+    """Orthonormalization constant K_{lm} (m >= 0), including sqrt(2) for m>0."""
+    k = math.sqrt((2 * l + 1) / (4 * math.pi)
+                  * math.factorial(l - m) / math.factorial(l + m))
+    if m > 0:
+        k *= math.sqrt(2.0)
+    return k
+
+
+@lru_cache(maxsize=None)
+def _double_factorial(n: int) -> int:
+    out = 1
+    while n > 1:
+        out *= n
+        n -= 2
+    return out
+
+
+def real_spherical_harmonics_all(l_max: int, xyz, xp=jnp) -> list:
+    """All real SH for l = 0..l_max at unit vectors xyz[..., 3].
+
+    Returns a list of arrays, entry l of shape [..., 2l+1] with m = -l..l.
+    `xp` selects the array backend (jnp for traced TPU code, np for host
+    float64 reference computations — both share the exact same math).
+    """
+    x, y, z = xyz[..., 0], xyz[..., 1], xyz[..., 2]
+
+    # A_m + i B_m = (x + i y)^m by recursion
+    A = [xp.ones_like(x)]
+    B = [xp.zeros_like(x)]
+    for m in range(1, l_max + 1):
+        A.append(x * A[m - 1] - y * B[m - 1])
+        B.append(x * B[m - 1] + y * A[m - 1])
+
+    # Ptil_l^m(z): CS-phase-free associated Legendre / sin^m, polynomial in z.
+    P = {}
+    for m in range(0, l_max + 1):
+        pmm = float(_double_factorial(2 * m - 1))
+        P[(m, m)] = pmm * xp.ones_like(z)
+        if m + 1 <= l_max:
+            P[(m + 1, m)] = (2 * m + 1) * pmm * z
+        for l in range(m + 2, l_max + 1):
+            P[(l, m)] = ((2 * l - 1) * z * P[(l - 1, m)]
+                         - (l + m - 1) * P[(l - 2, m)]) / (l - m)
+
+    out = []
+    for l in range(l_max + 1):
+        cols = []
+        for m in range(l, 0, -1):  # m = -l..-1 stored via B terms
+            cols.append(_norm_const(l, m) * P[(l, m)] * B[m])
+        cols.append(_norm_const(l, 0) * P[(l, 0)])
+        for m in range(1, l + 1):
+            cols.append(_norm_const(l, m) * P[(l, m)] * A[m])
+        out.append(xp.stack(cols, axis=-1))
+    return out
+
+
+def real_spherical_harmonics(l: int, xyz, xp=jnp):
+    """Real SH of a single degree l at unit vectors xyz[..., 3] -> [..., 2l+1]."""
+    return real_spherical_harmonics_all(l, xyz, xp=xp)[l]
+
+
+def angles_to_xyz(theta, phi, xp=np):
+    """Unit vector from polar angle theta (from +z) and azimuth phi."""
+    theta, phi = xp.asarray(theta), xp.asarray(phi)
+    return xp.stack([
+        xp.sin(theta) * xp.cos(phi),
+        xp.sin(theta) * xp.sin(phi),
+        xp.cos(theta),
+    ], axis=-1)
+
+
+def spherical_harmonics_angles(l: int, theta, phi, xp=np):
+    """Real SH of degree l parameterized by angles (host/test convenience)."""
+    return real_spherical_harmonics(l, angles_to_xyz(theta, phi, xp=xp), xp=xp)

@@ -1,0 +1,5 @@
+from .helpers import (
+    exists, default, uniq, to_order, map_values, safe_cat, cast_tuple,
+    batched_index_select, masked_mean, fourier_encode, broadcat, benchmark,
+    masked_fill,
+)

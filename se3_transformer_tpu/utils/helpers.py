@@ -1,0 +1,133 @@
+"""Generic tensor helpers (TPU-native analogues of reference utils.py).
+
+Reference: /root/reference/se3_transformer_pytorch/utils.py — this module
+re-provides the same helper surface (exists/default/to_order/
+batched_index_select/masked_mean/fourier_encode/broadcat/...) as pure
+jit-traceable JAX functions with static shapes.
+"""
+from __future__ import annotations
+
+import time
+from functools import wraps
+
+import jax
+import jax.numpy as jnp
+
+
+def exists(val):
+    return val is not None
+
+
+def default(val, d):
+    return val if exists(val) else d
+
+
+def uniq(arr):
+    return list({el: True for el in arr}.keys())
+
+
+def to_order(degree: int) -> int:
+    """Dimension of the degree-l irrep of SO(3): 2l + 1."""
+    return 2 * degree + 1
+
+
+def map_values(fn, d: dict) -> dict:
+    return {k: fn(v) for k, v in d.items()}
+
+
+def safe_cat(arr, el, axis):
+    if not exists(arr):
+        return el
+    return jnp.concatenate((arr, el), axis=axis)
+
+
+def cast_tuple(val, depth):
+    return val if isinstance(val, tuple) else (val,) * depth
+
+
+def batched_index_select(values: jnp.ndarray, indices: jnp.ndarray, axis: int = 1) -> jnp.ndarray:
+    """Gather `values` along `axis` with batched integer `indices`.
+
+    values:  [..., n, *value_dims]  where n sits at `axis`
+    indices: [..., *idx_dims] — leading dims must match values[:axis]
+    returns: values with axis `axis` replaced by idx_dims.
+
+    Equivalent of reference utils.py:56 (batched_index_select) expressed with
+    jnp.take_along_axis so XLA lowers it to a single gather.
+    """
+    value_dims = values.shape[axis + 1:]
+    batch_dims = values.shape[:axis]
+    idx_extra = indices.shape[len(batch_dims):]
+    flat_idx = indices.reshape(*batch_dims, -1)
+    # expand to match trailing value dims
+    expanded = flat_idx.reshape(flat_idx.shape + (1,) * len(value_dims))
+    expanded = jnp.broadcast_to(expanded, flat_idx.shape + value_dims)
+    out = jnp.take_along_axis(values, expanded, axis=axis)
+    return out.reshape(*batch_dims, *idx_extra, *value_dims)
+
+
+def masked_mean(tensor: jnp.ndarray, mask, axis: int = -1) -> jnp.ndarray:
+    """Mean over `axis` counting only entries where mask is True.
+
+    mask broadcasts from the left (trailing dims of tensor are kept).
+    Mirrors reference utils.py:72 semantics (0 where nothing is valid).
+    """
+    if mask is None:
+        return tensor.mean(axis=axis)
+    diff_len = tensor.ndim - mask.ndim
+    mask = mask.reshape(mask.shape + (1,) * diff_len)
+    tensor = jnp.where(mask, tensor, 0.)
+
+    total_el = mask.sum(axis=axis)
+    mean = tensor.sum(axis=axis) / jnp.clip(total_el, 1, None).astype(tensor.dtype)
+    return jnp.where(total_el == 0, 0., mean)
+
+
+def fourier_encode(x: jnp.ndarray, num_encodings: int = 4, include_self: bool = True,
+                   flatten: bool = True) -> jnp.ndarray:
+    """Sin/cos positional features at dyadic scales (reference utils.py:96)."""
+    x = x[..., None]
+    orig_x = x
+    scales = 2 ** jnp.arange(num_encodings, dtype=x.dtype)
+    x = x / scales
+    x = jnp.concatenate([jnp.sin(x), jnp.cos(x)], axis=-1)
+    if include_self:
+        x = jnp.concatenate((x, orig_x), axis=-1)
+    if flatten:
+        x = x.reshape(*x.shape[:3], -1)
+    return x
+
+
+def broadcat(tensors, axis=-1):
+    """Concatenate after broadcasting every non-concat dim to the max size
+    (reference utils.py:38)."""
+    ndim = tensors[0].ndim
+    assert all(t.ndim == ndim for t in tensors)
+    axis = axis % ndim
+    shapes = [list(t.shape) for t in tensors]
+    target = []
+    for d in range(ndim):
+        if d == axis:
+            target.append(None)
+        else:
+            target.append(max(s[d] for s in shapes))
+    out = []
+    for t in tensors:
+        shape = [t.shape[d] if d == axis else target[d] for d in range(ndim)]
+        out.append(jnp.broadcast_to(t, shape))
+    return jnp.concatenate(out, axis=axis)
+
+
+def benchmark(fn):
+    """Wall-clock a function call, blocking on JAX async dispatch."""
+    @wraps(fn)
+    def inner(*args, **kwargs):
+        start = time.time()
+        res = fn(*args, **kwargs)
+        res = jax.block_until_ready(res)
+        return time.time() - start, res
+    return inner
+
+
+def masked_fill(tensor, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=tensor.dtype), tensor)

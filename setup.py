@@ -1,0 +1,19 @@
+from setuptools import setup, find_packages
+
+setup(
+    name='se3-transformer-tpu',
+    packages=find_packages(exclude=('tests',)),
+    version='0.1.0',
+    license='MIT',
+    description='SE(3)-Transformer — TPU-native JAX/XLA/Pallas implementation',
+    python_requires='>=3.10',
+    install_requires=[
+        'jax',
+        'flax',
+        'optax',
+        'einops>=0.3',
+        'numpy',
+        'scipy',
+    ],
+    extras_require={'test': ['pytest']},
+)

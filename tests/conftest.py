@@ -1,0 +1,16 @@
+"""Test configuration: run on a simulated 8-device CPU mesh with x64 support.
+
+Environment must be set before jax initializes its backends, hence the
+top-of-module os.environ writes.
+"""
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_enable_x64', True)

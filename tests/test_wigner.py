@@ -1,0 +1,67 @@
+"""Representation-property tests for the real Wigner-D construction.
+
+Mirrors reference tests/test_irrep_repr.py (float64, orders 0..6) and adds
+orthogonality / homomorphism checks.
+"""
+import numpy as np
+import pytest
+
+from se3_transformer_tpu.so3 import (
+    compose, irr_repr, real_spherical_harmonics, rot, wigner_d_from_rotation,
+    x_to_alpha_beta,
+)
+
+ORDERS = range(7)
+
+
+@pytest.mark.parametrize('order', ORDERS)
+def test_representation_property(order):
+    """Y(R x) == D(R) Y(x), the core identity (reference test_irrep_repr.py)."""
+    rng = np.random.RandomState(order + 10)
+    abc = rng.uniform(-np.pi, np.pi, 3)
+    R = rot(*abc)
+    D = irr_repr(order, *abc)
+    pts = rng.normal(size=(40, 3))
+    pts /= np.linalg.norm(pts, axis=-1, keepdims=True)
+    Y = real_spherical_harmonics(order, pts, xp=np)
+    Yr = real_spherical_harmonics(order, pts @ R.T, xp=np)
+    scale = np.abs(Y).max()
+    assert np.abs(Yr - Y @ D.T).max() / scale < 1e-10
+
+
+@pytest.mark.parametrize('order', ORDERS)
+def test_homomorphism_and_orthogonality(order):
+    rng = np.random.RandomState(order)
+    a1, a2 = rng.uniform(-np.pi, np.pi, (2, 3))
+    D1, D2 = irr_repr(order, *a1), irr_repr(order, *a2)
+    D12 = wigner_d_from_rotation(order, rot(*a1) @ rot(*a2))
+    assert np.abs(D12 - D1 @ D2).max() < 1e-10
+    n = 2 * order + 1
+    assert np.abs(D1 @ D1.T - np.eye(n)).max() < 1e-12
+
+
+def test_compose_roundtrip():
+    rng = np.random.RandomState(7)
+    a1, a2 = rng.uniform(0, np.pi, (2, 3))
+    abc = compose(*a1, *a2)
+    assert np.abs(rot(*abc) - rot(*a1) @ rot(*a2)).max() < 1e-12
+
+
+def test_degree_one_is_cartesian_conjugate():
+    """D_1 must be the Cartesian rotation conjugated by the (y,z,x)->(x,y,z)
+    reordering implied by the real-SH m ordering."""
+    abc = (0.3, 1.2, -0.5)
+    R = rot(*abc)
+    D = irr_repr(1, *abc)
+    P = np.array([[0., 1., 0.],   # m=-1 -> y
+                  [0., 0., 1.],   # m=0  -> z
+                  [1., 0., 0.]])  # m=1  -> x
+    assert np.abs(D - P @ R @ P.T).max() < 1e-12
+
+
+def test_x_to_alpha_beta():
+    rng = np.random.RandomState(2)
+    x = rng.normal(size=3)
+    x /= np.linalg.norm(x)
+    a, b = x_to_alpha_beta(x)
+    assert np.abs(rot(a, b, 0.) @ np.array([0., 0., 1.]) - x).max() < 1e-12

@@ -1,0 +1,90 @@
+"""Benchmark: denoise-style training throughput on the flagship config.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config follows BASELINE.json's north star (1024 nodes, num_degrees=4,
+kNN neighbors) in a denoise.py-scale model. The reference publishes no
+benchmark numbers (BASELINE.md: "published": {}), so vs_baseline is
+reported against this repo's own first recorded value (RECORD below);
+1.0 until a prior record exists.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from se3_transformer_tpu.models.se3_transformer import SE3TransformerModule
+from se3_transformer_tpu.parallel.sharding import make_sharded_train_step
+
+# first recorded nodes*steps/sec/chip on TPU v5e-1 (update as it improves)
+RECORD = None
+
+NUM_NODES = 1024
+NUM_DEGREES = 4
+BATCH = 1
+NUM_NEIGHBORS = 32
+STEPS = 20
+
+
+def main():
+    module = SE3TransformerModule(
+        num_tokens=24, dim=8, dim_head=8, heads=2, depth=2,
+        attend_self=True, input_degrees=1, num_degrees=NUM_DEGREES,
+        output_degrees=2, reduce_dim_out=True, differentiable_coors=True,
+        num_neighbors=NUM_NEIGHBORS)
+
+    rng = np.random.RandomState(0)
+    seqs = jnp.asarray(rng.randint(0, 24, (BATCH, NUM_NODES)))
+    coords = jnp.asarray(np.cumsum(
+        rng.normal(size=(BATCH, NUM_NODES, 3)), axis=1), jnp.float32)
+    coords = coords - coords.mean(axis=1, keepdims=True)
+    masks = jnp.ones((BATCH, NUM_NODES), bool)
+
+    def loss_fn(params, batch, key):
+        noise = jax.random.normal(key, batch['coords'].shape,
+                                  batch['coords'].dtype)
+        noised = batch['coords'] + noise
+        out = module.apply({'params': params}, batch['seqs'], noised,
+                           mask=batch['masks'], return_type=1)
+        loss = (((noised + out) - batch['coords']) ** 2).sum(-1).mean()
+        return loss, dict()
+
+    # jit the init: eager init would dispatch thousands of tiny ops through
+    # the device tunnel and take minutes at 1024 nodes
+    init_fn = jax.jit(module.init, static_argnames=('return_type',))
+    params = init_fn(jax.random.PRNGKey(0), seqs, coords, mask=masks,
+                     return_type=1)['params']
+    optimizer = optax.adam(1e-4)
+    opt_state = optimizer.init(params)
+    step = make_sharded_train_step(loss_fn, optimizer)
+
+    batch = dict(seqs=seqs, coords=coords, masks=masks)
+    key = jax.random.PRNGKey(1)
+
+    # compile + warmup
+    params, opt_state, loss, _ = step(params, opt_state, batch, key)
+    jax.block_until_ready(loss)
+
+    t0 = time.time()
+    for i in range(STEPS):
+        key, sub = jax.random.split(key)
+        params, opt_state, loss, _ = step(params, opt_state, batch, sub)
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+
+    nodes_steps_per_sec = BATCH * NUM_NODES * STEPS / dt
+    vs = nodes_steps_per_sec / RECORD if RECORD else 1.0
+    print(json.dumps({
+        'metric': f'denoise_train_nodes_steps_per_sec_per_chip'
+                  f'(n={NUM_NODES},deg={NUM_DEGREES},k={NUM_NEIGHBORS})',
+        'value': round(nodes_steps_per_sec, 2),
+        'unit': 'nodes*steps/sec/chip',
+        'vs_baseline': round(vs, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -5,3 +5,9 @@ lucidrains/se3-transformer-pytorch, redesigned TPU-first.
 __version__ = '0.1.0'
 
 from .basis import get_basis, basis_transformation_Q_J
+from .ops import (
+    Fiber, LinearSE3, NormSE3, FeedForwardSE3, FeedForwardBlockSE3,
+    ConvSE3, RadialFunc, AttentionSE3, OneHeadedKVAttentionSE3,
+    AttentionBlockSE3, EGNN, EGnnNetwork,
+)
+from .models import SE3Transformer, SE3TransformerModule

@@ -1,0 +1,126 @@
+"""Pallas TPU kernel for the fused pairwise TFN convolution.
+
+This is THE compute hot spot of the model (SURVEY.md §3.3): per edge e and
+degree pair (d_in, d_out), the reference computes a radial profile
+R[e, o, i, f] with a per-pair MLP, multiplies by the angular basis
+B[e, P, Q, f] (P = 2*d_out+1, Q = 2*d_in+1) and contracts with gathered
+neighbor features x[e, i, Q]. The XLA path materializes R in HBM —
+2*E*o*i*f floats of traffic that dwarf the FLOPs (bandwidth-bound ~6x).
+
+This kernel fuses the final radial matmul with the contraction so R only
+ever exists as VMEM tiles:
+
+    inputs  H  [E, mid+1]      radial-MLP hidden (with folded-bias 1s col)
+            W3 [mid+1, IF, O]  final radial weight, (i, f) flattened
+            V2 [E, P, IF]      = sum_Q B[e,P,Q,f] x[e,i,Q]  (cheap, XLA)
+    per (if-chunk, e-block) program:
+            R   = H_blk @ W3_chunk            # MXU, shared weights
+            out += V2_chunk  @b R             # MXU, per-edge batched
+    output  out [E, P, O]
+
+Grid order is (n_if, n_e) with the output block revisited across the outer
+if-axis (accumulate), so W3 streams through VMEM once per if-chunk and the
+huge R tensor never touches HBM. The P axis rides the sublane dimension
+(P <= 7 pads to 8 — cheap), O rides lanes.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(h_ref, w3_ref, v2_ref, o_ref):
+    # R chunk: [E_b, IF_b, O] — exists only in VMEM
+    r = jax.lax.dot_general(
+        h_ref[:], w3_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    # per-edge batched contraction: [E_b, P, IF_b] x [E_b, IF_b, O].
+    # Each (f, e) program owns its own output block (partial sums over the
+    # if-axis are reduced outside the kernel): output blocks are never
+    # revisited, which keeps the TPU revisit rules trivially satisfied and
+    # W3 streaming to exactly one pass.
+    o_ref[0] = jax.lax.dot_general(
+        v2_ref[:], r,
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _pick_blocks(E: int, IF: int, O: int, mid: int,
+                 vmem_budget: int = 10 * 2 ** 20):
+    """Choose (block_e, block_if) so W3 chunk + R chunk + V2 fit in VMEM."""
+    block_if = min(IF, 128)
+    while True:
+        # W3 chunk + double-buffered R + H + V2 + out (f32 accounting)
+        for block_e in (256, 128, 64, 32, 16, 8):
+            w3 = mid * block_if * O * 4
+            r = block_e * block_if * O * 4
+            v2 = block_e * 8 * block_if * 4
+            out = block_e * 8 * O * 4
+            h = block_e * mid * 4
+            if w3 + 2 * r + v2 + out + h <= vmem_budget:
+                return block_e, block_if
+        if block_if <= 8:
+            return 8, block_if
+        block_if //= 2
+
+
+@functools.partial(jax.jit, static_argnames=('interpret',))
+def fused_pairwise_conv(h: jnp.ndarray, w3: jnp.ndarray, v2: jnp.ndarray,
+                        interpret: bool = False) -> jnp.ndarray:
+    """h [E, mid], w3 [mid, IF, O], v2 [E, P, IF] -> out [E, P, O] (f32).
+
+    Fold the radial bias by appending a ones column to h and the bias row
+    to w3 before calling (see PairwiseConvSE3).
+    """
+    E, mid = h.shape
+    _, IF, O = w3.shape
+    P = v2.shape[1]
+
+    block_e, block_if = _pick_blocks(E, IF, O, mid)
+
+    Ep = _round_up(E, block_e)
+    IFp = _round_up(IF, block_if)
+    if Ep != E:
+        h = jnp.pad(h, ((0, Ep - E), (0, 0)))
+        v2 = jnp.pad(v2, ((0, Ep - E), (0, 0), (0, 0)))
+    if IFp != IF:
+        w3 = jnp.pad(w3, ((0, 0), (0, IFp - IF), (0, 0)))
+        v2 = jnp.pad(v2, ((0, 0), (0, 0), (0, IFp - IF)))
+
+    n_if = IFp // block_if
+    n_e = Ep // block_e
+
+    out = pl.pallas_call(
+        _kernel,
+        grid=(n_if, n_e),
+        in_specs=[
+            pl.BlockSpec((block_e, mid), lambda f, e: (e, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((mid, block_if, O), lambda f, e: (0, f, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_e, P, block_if), lambda f, e: (e, 0, f),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_e, P, O), lambda f, e: (f, e, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_if, Ep, P, O), jnp.float32),
+        interpret=interpret,
+    )(h, w3, v2)
+
+    # reduce the per-if-chunk partial sums (n_if <= 7 for IF <= 896; XLA
+    # fuses this into a cheap elementwise pass)
+    return out.sum(axis=0)[:E]
+
+
+def pallas_available() -> bool:
+    return jax.default_backend() == 'tpu'

@@ -1,0 +1,1 @@
+from .se3_transformer import SE3Transformer, SE3TransformerModule

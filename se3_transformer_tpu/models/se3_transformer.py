@@ -1,0 +1,425 @@
+"""SE3Transformer — the flagship model / user API.
+
+TPU-native rework of reference SE3Transformer
+(/root/reference/se3_transformer_pytorch/se3_transformer_pytorch.py:936-1375)
+reproducing its full constructor surface (:937-982) and forward conventions
+(:1124-1134) as a flax.linen module with static shapes throughout:
+
+  * every data-dependent quantity of the reference (`.item()` topk sizes,
+    dynamic neighbor counts, boolean masked_select) becomes static config +
+    fixed-K top-k with validity masks — the jit/pjit-safe formulation;
+  * `reversible=True` maps to jax.checkpoint (rematerialized blocks) rather
+    than RevNet inverse math (same activation-memory class, exact
+    determinism through explicit PRNG keys — reference reversible.py);
+  * the basis is computed in-trace (polynomial SH) with Q_J constants baked
+    at trace time; `differentiable_coors` honestly gates coordinate
+    gradients via stop_gradient.
+
+A thin eager wrapper (`SE3Transformer`) holds params and mimics the
+reference's call signature; the functional module (`SE3TransformerModule`)
+is what you jit / pjit / shard.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..basis import get_basis
+from ..ops.conv import ConvSE3
+from ..ops.trunk import SequentialTrunk
+from ..ops.core import LinearSE3, NormSE3
+from ..ops.egnn import EGnnNetwork
+from ..ops.fiber import Fiber
+from ..ops.neighbors import (
+    exclude_self_indices, expand_adjacency, remove_self, select_neighbors,
+    sparse_neighbor_mask,
+)
+from ..ops.rotary import sinusoidal_embeddings
+from ..utils.helpers import (
+    batched_index_select, cast_tuple, masked_mean, safe_cat,
+)
+
+Features = Dict[str, jnp.ndarray]
+
+# Degree-1 features are Cartesian (x, y, z) at the user boundary — the
+# reference's contract (tests rotate them with the raw 3x3 matrix). Our
+# real-SH irrep ordering is m = (-1, 0, 1) ~ (y, z, x), so convert on the
+# way in/out; D_1 = P R P^T makes type-1 outputs transform exactly as
+# Cartesian vectors (see tests/test_wigner.py::
+# test_degree_one_is_cartesian_conjugate).
+_CART_TO_IRREP = (1, 2, 0)
+_IRREP_TO_CART = (2, 0, 1)
+
+
+def _permute_degree1(features: Features, perm) -> Features:
+    if '1' not in features:
+        return features
+    t = features['1']
+    return {**features, '1': t[..., jnp.asarray(perm)]}
+
+
+class SE3TransformerModule(nn.Module):
+    """Functional SE(3)-Transformer. Field-for-field parity with the
+    reference constructor (se3_transformer_pytorch.py:937-982)."""
+    dim: Union[int, Tuple[int, ...]]
+    heads: int = 8
+    dim_head: int = 24
+    depth: int = 2
+    input_degrees: int = 1
+    num_degrees: Optional[int] = None
+    output_degrees: int = 1
+    valid_radius: float = 1e5
+    reduce_dim_out: bool = False
+    num_tokens: Optional[int] = None
+    num_positions: Optional[int] = None
+    num_edge_tokens: Optional[int] = None
+    edge_dim: Optional[int] = None
+    reversible: bool = False
+    attend_self: bool = True
+    use_null_kv: bool = False
+    differentiable_coors: bool = False
+    fourier_encode_dist: bool = False
+    rel_dist_num_fourier_features: int = 4
+    num_neighbors: Union[int, float] = float('inf')
+    attend_sparse_neighbors: bool = False
+    num_adj_degrees: Optional[int] = None
+    adj_dim: int = 0
+    max_sparse_neighbors: Union[int, float] = float('inf')
+    dim_in: Optional[Union[int, Tuple[int, ...]]] = None
+    dim_out: Optional[int] = None
+    norm_out: bool = False
+    num_conv_layers: int = 0
+    causal: bool = False
+    global_feats_dim: Optional[int] = None
+    linear_proj_keys: bool = False
+    one_headed_key_values: bool = False
+    tie_key_values: bool = False
+    rotary_position: bool = False
+    rotary_rel_dist: bool = False
+    norm_gated_scale: bool = False
+    use_egnn: bool = False
+    egnn_hidden_dim: int = 32
+    egnn_weights_clamp_value: Optional[float] = None
+    egnn_feedforward: bool = False
+    hidden_fiber_dict: Optional[Dict[int, int]] = None
+    out_fiber_dict: Optional[Dict[int, int]] = None
+    # None -> auto (Pallas fused pairwise kernel on TPU, XLA elsewhere)
+    pallas: Optional[bool] = None
+
+    # ------------------------------------------------------------------ #
+    # static configuration helpers (resolved at trace time)
+    # ------------------------------------------------------------------ #
+    def _resolved(self):
+        assert self.num_degrees is not None or self.hidden_fiber_dict is not None, \
+            'either num_degrees or hidden_fiber_dict must be specified'
+        num_degrees = self.num_degrees if self.num_degrees is not None \
+            else (max(self.hidden_fiber_dict.keys()) + 1)
+
+        dim_in = self.dim_in if self.dim_in is not None else self.dim
+        fiber_in = Fiber.create(self.input_degrees,
+                                cast_tuple(dim_in, self.input_degrees))
+
+        if self.hidden_fiber_dict is not None:
+            fiber_hidden = Fiber(self.hidden_fiber_dict)
+        else:
+            fiber_hidden = Fiber.create(num_degrees, self.dim)
+
+        output_degrees = self.output_degrees if not self.use_egnn else None
+        dim_out = self.dim_out if self.dim_out is not None else self.dim
+        if self.out_fiber_dict is not None:
+            fiber_out = Fiber(self.out_fiber_dict)
+            output_degrees = max(self.out_fiber_dict.keys()) + 1
+        elif output_degrees is not None:
+            fiber_out = Fiber.create(output_degrees, dim_out)
+        else:
+            fiber_out = None
+        return num_degrees, fiber_in, fiber_hidden, fiber_out, output_degrees
+
+    @nn.compact
+    def __call__(self, feats, coors, mask=None, adj_mat=None, edges=None,
+                 return_type=None, return_pooled=False, neighbor_mask=None,
+                 global_feats=None):
+        num_degrees, fiber_in, fiber_hidden, fiber_out, output_degrees = \
+            self._resolved()
+
+        assert not (self.accept_global_feats ^ (global_feats is not None)), \
+            'global features must be passed iff global_feats_dim is set'
+        assert not (self.causal and not self.attend_self), \
+            'attend_self must be on in causal (autoregressive) mode'
+        assert not (self.attend_sparse_neighbors and adj_mat is None), \
+            'adjacency matrix must be passed in when attending to sparse neighbors'
+        assert not (self.has_edges and edges is None), \
+            'edge tokens/features must be supplied when edge_dim is set'
+
+        if output_degrees == 1:
+            return_type = 0
+
+        # ------------------------------------------------------------- #
+        # embeddings (reference :1143-1158)
+        # ------------------------------------------------------------- #
+        if self.num_tokens is not None:
+            feats = nn.Embed(self.num_tokens, self._scalar_dim(),
+                             name='token_emb')(feats)
+        if self.num_positions is not None:
+            n_ = feats.shape[1]
+            assert n_ <= self.num_positions, \
+                'sequence length exceeds num_positions'
+            pos = nn.Embed(self.num_positions, self._scalar_dim(),
+                           name='pos_emb')(jnp.arange(n_))
+            feats = feats + pos[None]
+
+        if not isinstance(feats, dict):
+            feats = {'0': feats[..., None]}
+        feats = _permute_degree1(feats, _CART_TO_IRREP)
+        if global_feats is not None and not isinstance(global_feats, dict):
+            global_feats = {'0': global_feats[..., None]}
+
+        b, n = feats['0'].shape[0], feats['0'].shape[1]
+        assert feats['0'].shape[2] == fiber_in[0], \
+            f"feature dim {feats['0'].shape[2]} != configured {fiber_in[0]}"
+        assert set(map(int, feats.keys())) == set(range(self.input_degrees)), \
+            f'input must have degrees 0..{self.input_degrees - 1}'
+
+        # static neighbor budget (reference :1277-1281, made static)
+        neighbors = self.num_neighbors
+        assert self.attend_sparse_neighbors or neighbors > 0, \
+            'either attend to sparse neighbors or use num_neighbors > 0'
+        neighbors = int(min(neighbors, n - 1))
+
+        num_sparse = 0
+        sparse_mask = None
+        adj_indices = None
+        self_excl = exclude_self_indices(n)
+
+        if adj_mat is not None and adj_mat.ndim == 2:
+            adj_mat = jnp.broadcast_to(adj_mat[None], (b, n, n))
+
+        # N-hop adjacency ring labels (reference :1177-1191)
+        if self.num_adj_degrees is not None:
+            assert self.num_adj_degrees >= 1, \
+                'num_adj_degrees must be at least 1'
+            adj_mat, adj_ind_full = expand_adjacency(adj_mat,
+                                                     self.num_adj_degrees)
+            adj_indices = remove_self(adj_ind_full, self_excl)
+
+        # sparse (bonded) neighbors from the ORIGINAL 1-hop + expanded mat
+        # (reference :1195-1217)
+        if self.attend_sparse_neighbors:
+            adj_noself = remove_self(adj_mat, self_excl)
+            max_sparse = self.max_sparse_neighbors
+            num_sparse = int(min(max_sparse, n - 1))
+            noise = jax.random.uniform(
+                jax.random.PRNGKey(0), adj_noself.shape,
+                minval=-0.01, maxval=0.01)
+            sparse_mask = sparse_neighbor_mask(adj_noself, num_sparse, noise)
+
+        # pairwise geometry, self-excluded by construction (reference :1221-1229)
+        rel_pos_full = coors[:, :, None, :] - coors[:, None, :, :]
+        rel_pos = remove_self(rel_pos_full, self_excl)
+        indices = jnp.broadcast_to(self_excl[None], (b, n, n - 1))
+
+        pair_mask = None
+        if mask is not None:
+            pm = mask[:, :, None] & mask[:, None, :]
+            pair_mask = remove_self(pm, self_excl)
+
+        # edges (reference :1231-1239)
+        if edges is not None:
+            if self.num_edge_tokens is not None:
+                edges = nn.Embed(self.num_edge_tokens, self.edge_dim,
+                                 name='edge_emb')(edges)
+            edges = remove_self(edges, self_excl)
+        if self.num_adj_degrees is not None and self.adj_dim > 0:
+            adj_emb = nn.Embed(self.num_adj_degrees + 1, self.adj_dim,
+                               name='adj_emb')(adj_indices)
+            edges = jnp.concatenate((edges, adj_emb), axis=-1) \
+                if edges is not None else adj_emb
+
+        if neighbor_mask is not None:
+            neighbor_mask = remove_self(neighbor_mask, self_excl)
+
+        # fixed-K neighbor selection (reference :1241-1294)
+        valid_radius = self.valid_radius if neighbors > 0 else 0.
+        total_neighbors = int(min(neighbors + num_sparse, n - 1))
+        assert total_neighbors > 0, 'must fetch at least 1 neighbor'
+
+        hood, nearest = select_neighbors(
+            rel_pos, indices, total_neighbors, valid_radius,
+            pair_mask=pair_mask, neighbor_mask=neighbor_mask,
+            sparse_mask=sparse_mask, causal=self.causal)
+
+        if edges is not None:
+            edges = batched_index_select(edges, nearest, axis=2)
+
+        # rotary embeddings (reference :1298-1325)
+        pos_emb = self._rotary_embeddings(b, n, hood)
+
+        # basis, in-trace (reference :1329)
+        basis = get_basis(hood.rel_pos, num_degrees - 1,
+                          differentiable=self.differentiable_coors)
+
+        edge_info = (hood.indices, hood.mask, edges)
+        x = feats
+
+        conv_kwargs = dict(
+            edge_dim=(edges.shape[-1] if edges is not None else 0),
+            fourier_encode_dist=self.fourier_encode_dist,
+            num_fourier_features=self.rel_dist_num_fourier_features,
+            pallas=self.pallas)
+
+        # project in + pre-convs (reference :1338-1344)
+        x = ConvSE3(fiber_in, fiber_hidden, name='conv_in', **conv_kwargs)(
+            x, edge_info, hood.rel_dist, basis)
+        for i in range(self.num_conv_layers):
+            x = NormSE3(fiber_hidden, gated_scale=self.norm_gated_scale,
+                        name=f'preconv_norm{i}')(x)
+            x = ConvSE3(fiber_hidden, fiber_hidden, name=f'preconv{i}',
+                        **conv_kwargs)(x, edge_info, hood.rel_dist, basis)
+
+        # trunk (reference :1096-1109, :1348)
+        x = self._trunk(x, fiber_hidden, edge_info, hood.rel_dist, basis,
+                        global_feats, pos_emb, mask, conv_kwargs)
+
+        # project out (reference :1352-1363)
+        if fiber_out is not None:
+            x = ConvSE3(fiber_hidden, fiber_out, name='conv_out',
+                        **conv_kwargs)(x, edge_info, hood.rel_dist, basis)
+
+        if (self.norm_out or self.reversible) and fiber_out is not None:
+            x = NormSE3(fiber_out, gated_scale=self.norm_gated_scale,
+                        nonlin=lambda t: t, name='norm_out')(x)
+
+        final_fiber = fiber_out if fiber_out is not None else fiber_hidden
+        if self.reduce_dim_out:
+            x = LinearSE3(final_fiber, final_fiber.to(1),
+                          name='linear_out')(x)
+            x = {k: v[..., 0, :] for k, v in x.items()}
+
+        x = _permute_degree1(x, _IRREP_TO_CART)
+
+        # output conventions (reference :1365-1375)
+        if return_pooled:
+            pool = (lambda t: masked_mean(t, mask, axis=1)) if mask is not None \
+                else (lambda t: t.mean(axis=1))
+            x = {k: pool(v) for k, v in x.items()}
+        if '0' in x:
+            x = {**x, '0': x['0'][..., 0]}
+        if return_type is not None:
+            return x[str(return_type)]
+        return x
+
+    # ------------------------------------------------------------------ #
+    @property
+    def accept_global_feats(self) -> bool:
+        return self.global_feats_dim is not None
+
+    @property
+    def has_edges(self) -> bool:
+        return self.edge_dim is not None and self.edge_dim > 0
+
+    def _scalar_dim(self) -> int:
+        dim_in = self.dim_in if self.dim_in is not None else self.dim
+        return cast_tuple(dim_in, self.input_degrees)[0]
+
+    def _rotary_embeddings(self, b, n, hood):
+        if not (self.rotary_position or self.rotary_rel_dist):
+            return None
+        num_rotaries = int(self.rotary_position) + int(self.rotary_rel_dist)
+        rot_dim = self.dim_head // num_rotaries
+
+        key_pos_emb = None
+        query_pos_emb = None
+
+        if self.rotary_position:
+            seq_emb = sinusoidal_embeddings(jnp.arange(n), rot_dim)  # [n, r]
+            idx_with_self = jnp.concatenate(
+                (jnp.broadcast_to(jnp.arange(n)[None, :, None],
+                                  (b, n, 1)).astype(hood.indices.dtype),
+                 hood.indices), axis=2)
+            key_pos_emb = seq_emb[idx_with_self]           # [b, n, 1+k, r]
+            query_pos_emb = jnp.broadcast_to(seq_emb[None], (b, n, rot_dim))
+
+        if self.rotary_rel_dist:
+            dist_with_self = jnp.pad(
+                hood.rel_dist, ((0, 0), (0, 0), (1, 0))) * 1e2
+            rel_emb = sinusoidal_embeddings(dist_with_self, rot_dim)
+            key_pos_emb = safe_cat(key_pos_emb, rel_emb, axis=-1)
+            q_emb = sinusoidal_embeddings(jnp.zeros((n,)), rot_dim)
+            query_pos_emb = safe_cat(
+                query_pos_emb, jnp.broadcast_to(q_emb[None], (b, n, rot_dim)),
+                axis=-1)
+
+        return (query_pos_emb, key_pos_emb)
+
+    def _trunk(self, x, fiber_hidden, edge_info, rel_dist, basis,
+               global_feats, pos_emb, mask, conv_kwargs):
+        if self.use_egnn:
+            return EGnnNetwork(
+                fiber=fiber_hidden, depth=self.depth,
+                edge_dim=conv_kwargs['edge_dim'],
+                hidden_dim=self.egnn_hidden_dim,
+                coor_weights_clamp_value=self.egnn_weights_clamp_value,
+                feedforward=self.egnn_feedforward, name='egnn_net')(
+                    x, edge_info, rel_dist, basis=basis,
+                    global_feats=global_feats, pos_emb=pos_emb, mask=mask)
+
+        assert not (self.reversible and self.accept_global_feats), \
+            'reversibility and global features are not compatible'
+
+        return SequentialTrunk(
+            fiber_hidden, depth=self.depth, heads=self.heads,
+            dim_head=self.dim_head, attend_self=self.attend_self,
+            edge_dim=conv_kwargs['edge_dim'],
+            use_null_kv=self.use_null_kv,
+            fourier_encode_dist=self.fourier_encode_dist,
+            rel_dist_num_fourier_features=self.rel_dist_num_fourier_features,
+            global_feats_dim=self.global_feats_dim,
+            linear_proj_keys=self.linear_proj_keys,
+            tie_key_values=self.tie_key_values,
+            one_headed_key_values=self.one_headed_key_values,
+            norm_gated_scale=self.norm_gated_scale,
+            reversible=self.reversible, pallas=self.pallas, name='trunk')(
+                x, edge_info, rel_dist, basis, global_feats, pos_emb, mask)
+
+
+class SE3Transformer:
+    """Eager convenience wrapper mirroring the reference's call style:
+
+        model = SE3Transformer(dim=64, depth=2, num_degrees=2)
+        out = model(feats, coors, mask, return_type=0)
+
+    Parameters are initialized lazily on first call (seeded). For
+    production TPU use, jit `model.module.apply` (or use
+    se3_transformer_tpu.training) — this wrapper is for parity tests and
+    interactive exploration.
+    """
+
+    def __init__(self, *, seed: int = 0, **kwargs):
+        self.module = SE3TransformerModule(**kwargs)
+        self.seed = seed
+        self.params = None
+        self._apply = jax.jit(
+            self.module.apply,
+            static_argnames=('return_type', 'return_pooled'))
+
+    def init(self, rng, *args, **kwargs):
+        self.params = self.module.init(rng, *args, **kwargs)['params']
+        return self.params
+
+    def __call__(self, feats, coors, mask=None, adj_mat=None, edges=None,
+                 return_type=None, return_pooled=False, neighbor_mask=None,
+                 global_feats=None):
+        kwargs = dict(mask=mask, adj_mat=adj_mat, edges=edges,
+                      return_type=return_type, return_pooled=return_pooled,
+                      neighbor_mask=neighbor_mask, global_feats=global_feats)
+        if self.params is None:
+            init_fn = jax.jit(
+                self.module.init,
+                static_argnames=('return_type', 'return_pooled'))
+            self.params = init_fn(jax.random.PRNGKey(self.seed), feats,
+                                  coors, **kwargs)['params']
+        return self._apply({'params': self.params}, feats, coors, **kwargs)

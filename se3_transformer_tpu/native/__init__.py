@@ -1,0 +1,4 @@
+from .loader import (
+    native_available, chain_adjacency, expand_adjacency, knn_graph,
+    pad_batch, get_lib,
+)

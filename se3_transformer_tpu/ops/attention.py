@@ -40,6 +40,7 @@ class AttentionSE3(nn.Module):
     global_feats_dim: Optional[int] = None
     linear_proj_keys: bool = False
     tie_key_values: bool = False
+    pallas: Optional[bool] = None
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -64,7 +65,8 @@ class AttentionSE3(nn.Module):
             pool=False, self_interaction=False,
             edge_dim=self.edge_dim or 0,
             fourier_encode_dist=self.fourier_encode_dist,
-            num_fourier_features=self.rel_dist_num_fourier_features)
+            num_fourier_features=self.rel_dist_num_fourier_features,
+            pallas=self.pallas)
 
         queries = LinearSE3(self.fiber, hidden_fiber, name='to_q')(features)
         values = ConvSE3(self.fiber, kv_fiber, name='to_v', **conv_kwargs)(
@@ -190,6 +192,7 @@ class AttentionBlockSE3(nn.Module):
     tie_key_values: bool = False
     one_headed_key_values: bool = False
     norm_gated_scale: bool = False
+    pallas: Optional[bool] = None
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -209,6 +212,7 @@ class AttentionBlockSE3(nn.Module):
             global_feats_dim=self.global_feats_dim,
             linear_proj_keys=self.linear_proj_keys,
             tie_key_values=self.tie_key_values,
+            pallas=self.pallas,
             name='attn')(out, edge_info, rel_dist, basis, global_feats,
                          pos_emb, mask)
         return residual_se3(out, res)

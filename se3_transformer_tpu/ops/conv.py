@@ -7,22 +7,27 @@ Key departure from the reference: the reference materializes, per edge, the
 full unary kernel matrix [(2*do+1)*c_out, (2*di+1)*c_in] (PairwiseConv,
 :326-343) and then multiplies it with the gathered features, chunking the
 node axis into `splits` pieces to survive the peak memory (:222-254). Here
-the radial profile R, the angular basis B and the neighbor features x are
-contracted in a fused einsum chain
+the angular basis is contracted with the neighbor features FIRST (cheap,
+small axes), and the radial profile is applied as one big channel
+contraction:
 
-    W[o, m_J..] = sum_i R[o, i, f] x[i, m_in]        (channel contraction)
-    y[o, m_out] = sum_{m_in, f} W[o, m_in, f] B[m_out, m_in, f]
+    V2[P, (i,f)]  = sum_Q B[P, Q, f] x[i, Q]          # VPU-sized
+    out[P, o]     = sum_{(i,f)} V2[P, (i,f)] R[(i,f), o]   # MXU
 
-so the [oP x iQ] kernel never exists in HBM; XLA tiles the big channel
-contraction onto the MXU and fuses the small (2l+1)-sized contractions into
-it. No `splits` knob is needed — rematerialization (jax.checkpoint at the
-trunk level) plus XLA fusion replace eager chunking.
+so the [oP x iQ] kernel never exists, and on TPU the radial tensor R
+itself never leaves VMEM either: kernels.pallas_pairwise fuses the final
+radial matmul with the contraction (the XLA fallback materializes R, which
+is what the einsum path costs anyway). No `splits` knob is needed —
+rematerialization (jax.checkpoint at the trunk level) plus fusion replace
+eager chunking.
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict, Optional, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ..utils.helpers import (
@@ -41,7 +46,8 @@ class RadialFunc(nn.Module):
     """Per-edge radial profile MLP (reference :270-299).
 
     edge scalar features [..., edge_dim+1] -> R [..., c_out, c_in, num_freq].
-    This is the dominant matmul of the model: [b*n*k, mid] @ [mid, o*i*f].
+    Kept for API parity / inspection; PairwiseConvSE3 holds the same
+    parameters but fuses the final matmul into the pairwise contraction.
     """
     num_freq: int
     in_dim: int
@@ -51,30 +57,117 @@ class RadialFunc(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        x = nn.Dense(self.mid_dim)(x)
-        x = nn.LayerNorm()(x)
-        x = nn.gelu(x)
-        x = nn.Dense(self.mid_dim)(x)
-        x = nn.LayerNorm()(x)
-        x = nn.gelu(x)
+        x = radial_hidden(x, self.mid_dim)
         x = nn.Dense(self.num_freq * self.in_dim * self.out_dim)(x)
         return x.reshape(*x.shape[:-1], self.out_dim, self.in_dim,
                          self.num_freq)
 
 
+def radial_hidden(x: jnp.ndarray, mid_dim: int) -> jnp.ndarray:
+    """Shared 2-layer radial trunk: Dense -> LN -> GELU, twice."""
+    x = nn.Dense(mid_dim)(x)
+    x = nn.LayerNorm()(x)
+    x = nn.gelu(x)
+    x = nn.Dense(mid_dim)(x)
+    x = nn.LayerNorm()(x)
+    x = nn.gelu(x)
+    return x
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _pairwise_contract_pallas(h, w3b, v2, interpret=False):
+    from ..kernels.pallas_pairwise import fused_pairwise_conv
+    return fused_pairwise_conv(h, w3b, v2, interpret=interpret)
+
+
+def _pc_fwd(h, w3b, v2, interpret=False):
+    return _pairwise_contract_pallas(h, w3b, v2, interpret), (h, w3b, v2)
+
+
+def _pc_bwd(interpret, res, g):
+    # backward via XLA einsums (materializes R for the backward only; a
+    # fused backward kernel is a later optimization)
+    h, w3b, v2 = res
+    R = jnp.einsum('em,mko->eko', h, w3b)
+    dv2 = jnp.einsum('epo,eko->epk', g, R)
+    dR = jnp.einsum('epk,epo->eko', v2, g)
+    dh = jnp.einsum('eko,mko->em', dR, w3b)
+    dw3 = jnp.einsum('em,eko->mko', h, dR)
+    return dh, dw3, dv2
+
+
+_pairwise_contract_pallas.defvjp(_pc_fwd, _pc_bwd)
+
+
+class PairwiseConvSE3(nn.Module):
+    """Single (d_in -> d_out) pairwise kernel + contraction
+    (reference PairwiseConv :301-343, fused).
+
+    `pallas=None` auto-selects the TPU kernel; the parameter tree is
+    identical for both paths, so checkpoints are portable and the Pallas
+    path is numerics-gated against the XLA path in tests.
+    """
+    degree_in: int
+    nc_in: int
+    degree_out: int
+    nc_out: int
+    mid_dim: int = 128
+    pallas: Optional[bool] = None
+    pallas_interpret: bool = False
+
+    @nn.compact
+    def __call__(self, edge_feats: jnp.ndarray, basis_slice: jnp.ndarray,
+                 x: jnp.ndarray) -> jnp.ndarray:
+        """edge_feats [b,n,k,e]; basis_slice [b,n,k,P,Q,F]; x [b,n,k,c_in,Q]
+        -> [b,n,k,c_out,P]"""
+        F = to_order(min(self.degree_in, self.degree_out))
+        P = to_order(self.degree_out)
+        IF = self.nc_in * F
+
+        h = radial_hidden(edge_feats, self.mid_dim)          # [b,n,k,mid]
+
+        w3 = self.param(
+            'w3',
+            nn.initializers.variance_scaling(1.0, 'fan_in', 'truncated_normal',
+                                             in_axis=0, out_axis=(1, 2)),
+            (self.mid_dim, IF, self.nc_out), h.dtype)
+        b3 = self.param('b3', nn.initializers.zeros, (IF, self.nc_out),
+                        h.dtype)
+
+        # V2[..., P, (i, f)] = sum_Q B[..., P, Q, f] x[..., i, Q]
+        v2 = jnp.einsum('...pqf,...cq->...pcf', basis_slice, x)
+        v2 = v2.reshape(*v2.shape[:-2], IF)  # [..., P, c_in*F]
+
+        use_pallas = self.pallas
+        if use_pallas is None:
+            use_pallas = jax.default_backend() == 'tpu'
+
+        lead = h.shape[:-1]
+        if use_pallas or self.pallas_interpret:
+            E = 1
+            for s in lead:
+                E *= s
+            h2 = h.reshape(E, self.mid_dim)
+            v22 = v2.reshape(E, P, IF)
+            # fold bias: ones column on h, bias row on w3
+            h2 = jnp.concatenate(
+                [h2, jnp.ones((E, 1), h2.dtype)], axis=-1)
+            w3b = jnp.concatenate([w3, b3[None]], axis=0)
+            out = _pairwise_contract_pallas(h2, w3b, v22,
+                                            self.pallas_interpret)
+            out = out.reshape(*lead, P, self.nc_out)
+        else:
+            R = jnp.einsum('...m,mko->...ko', h, w3) + b3
+            out = jnp.einsum('...pk,...ko->...po', v2, R)
+
+        return jnp.swapaxes(out, -1, -2)  # [..., c_out, P]
+
+
 def pairwise_conv_contract(R: jnp.ndarray, B: jnp.ndarray,
                            x: jnp.ndarray) -> jnp.ndarray:
-    """Fused (radial x basis x features) contraction for one degree pair.
-
-    R: [b, n, k, c_out, c_in, f]   radial profiles
-    B: [b, n, k, 2*do+1, 2*di+1, f] angular basis
-    x: [b, n, k, c_in, 2*di+1]     gathered neighbor features
-    -> [b, n, k, c_out, 2*do+1]
-
-    Replaces reference PairwiseConv's explicit frequency loop + kernel
-    materialization (:336-343) and the kernel @ features einsum (:251).
-    """
-    # channel contraction first (big, MXU-friendly), small angular axes last
+    """Reference-ordered fused contraction for one degree pair (kept for
+    tests / comparison): R [...,c_out,c_in,f], B [...,P,Q,f],
+    x [...,c_in,Q] -> [...,c_out,P]."""
     W = jnp.einsum('...oif,...iq->...oqf', R, x)
     return jnp.einsum('...oqf,...pqf->...op', W, B)
 
@@ -89,6 +182,8 @@ class ConvSE3(nn.Module):
     edge_dim: int = 0
     fourier_encode_dist: bool = False
     num_fourier_features: int = 4
+    pallas: Optional[bool] = None
+    pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, inp: Features, edge_info: EdgeInfo,
@@ -116,13 +211,14 @@ class ConvSE3(nn.Module):
         for degree_out, m_out in self.fiber_out:
             acc = None
             for degree_in, m_in in self.fiber_in:
-                num_freq = to_order(min(degree_in, degree_out))
-                R = RadialFunc(
-                    num_freq, m_in, m_out,
-                    edge_dim=edge_features.shape[-1] - 1,
-                    name=f'radial_{degree_in}_{degree_out}')(edge_features)
-                B = basis[f'{degree_in},{degree_out}']
-                y = pairwise_conv_contract(R, B, gathered[str(degree_in)])
+                y = PairwiseConvSE3(
+                    degree_in, m_in, degree_out, m_out,
+                    pallas=self.pallas,
+                    pallas_interpret=self.pallas_interpret,
+                    name=f'pair_{degree_in}_{degree_out}')(
+                        edge_features,
+                        basis[f'{degree_in},{degree_out}'],
+                        gathered[str(degree_in)])
                 acc = y if acc is None else acc + y
 
             if self.pool:

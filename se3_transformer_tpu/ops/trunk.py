@@ -1,0 +1,78 @@
+"""Trunk execution strategies (the reference's L3 layer).
+
+Reference reversible.py provides two ways to run the stack of
+(attention, feedforward) blocks: SequentialSequence (:189-198) and
+ReversibleSequence (:200-220), the latter a hand-rolled RevNet with RNG
+state capture/replay for O(1) activation memory.
+
+TPU-native equivalents:
+
+  * SequentialTrunk — plain unrolled loop (XLA fuses across blocks).
+  * reversible=True -> the same trunk with every block wrapped in
+    jax.checkpoint (flax nn.remat): activations are rematerialized in the
+    backward pass, giving the same activation-memory class as RevNet with
+    no inverse math and exact determinism (JAX PRNG keys are explicit, so
+    the reference's Deterministic RNG fork at reversible.py:59-89 has no
+    analogue to port — determinism is free).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .attention import AttentionBlockSE3
+from .core import FeedForwardBlockSE3
+from .fiber import Fiber
+
+Features = Dict[str, jnp.ndarray]
+
+
+class SequentialTrunk(nn.Module):
+    """depth x (AttentionBlockSE3 -> FeedForwardBlockSE3); reversible=True
+    rematerializes each block (reference ReversibleSequence replacement)."""
+    fiber: Fiber
+    depth: int
+    heads: int = 8
+    dim_head: int = 24
+    attend_self: bool = False
+    edge_dim: int = 0
+    use_null_kv: bool = False
+    fourier_encode_dist: bool = False
+    rel_dist_num_fourier_features: int = 4
+    global_feats_dim: Optional[int] = None
+    linear_proj_keys: bool = False
+    tie_key_values: bool = False
+    one_headed_key_values: bool = False
+    norm_gated_scale: bool = False
+    reversible: bool = False
+    pallas: Optional[bool] = None
+
+    @nn.compact
+    def __call__(self, x: Features, edge_info, rel_dist, basis,
+                 global_feats=None, pos_emb=None, mask=None) -> Features:
+        attn_cls, ff_cls = AttentionBlockSE3, FeedForwardBlockSE3
+        if self.reversible:
+            attn_cls = nn.remat(AttentionBlockSE3)
+            ff_cls = nn.remat(FeedForwardBlockSE3)
+
+        for i in range(self.depth):
+            x = attn_cls(
+                self.fiber, heads=self.heads, dim_head=self.dim_head,
+                attend_self=self.attend_self, edge_dim=self.edge_dim,
+                use_null_kv=self.use_null_kv,
+                fourier_encode_dist=self.fourier_encode_dist,
+                rel_dist_num_fourier_features=self.rel_dist_num_fourier_features,
+                global_feats_dim=self.global_feats_dim,
+                linear_proj_keys=self.linear_proj_keys,
+                tie_key_values=self.tie_key_values,
+                one_headed_key_values=self.one_headed_key_values,
+                norm_gated_scale=self.norm_gated_scale,
+                pallas=self.pallas,
+                name=f'attn_block{i}')(
+                    x, edge_info, rel_dist, basis, global_feats, pos_emb,
+                    mask)
+            x = ff_cls(self.fiber, norm_gated_scale=self.norm_gated_scale,
+                       name=f'ff_block{i}')(x)
+        return x

@@ -1,0 +1,4 @@
+from .mesh import make_mesh, shard_batch, data_specs, MESH_AXES
+from .sharding import (
+    make_sharded_train_step, make_accumulating_train_step, replicated,
+)

@@ -1,0 +1,112 @@
+"""Device-mesh construction for dp/sp/tp SPMD execution.
+
+The reference is single-process, single-device (SURVEY.md §2.9); the only
+multi-device artifact is an aspirational comment (reference
+reversible.py:91-92). Here multi-chip is first-class: one
+jax.sharding.Mesh with three axes
+
+  * dp — data parallel over the batch axis,
+  * sp — sequence/node parallel over the query-node axis (the O(N^2)
+    distance/top-k and O(N*K) basis/conv/attention work partition cleanly
+    by query node; gathers of source-node features become XLA all-gathers
+    over ICI),
+  * tp — tensor parallel over heads/hidden channels.
+
+XLA's GSPMD inserts the collectives (all_gather / psum / reduce_scatter)
+from sharding annotations — there is no hand-written transport layer, which
+is the TPU-native equivalent of an NCCL/MPI backend. `jax.distributed` +
+the same mesh covers multi-host (ICI intra-slice, DCN across slices).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ('dp', 'sp', 'tp')
+
+
+def _factorize(n: int, ways: int = 3) -> tuple:
+    """Split n into `ways` near-equal power factors, largest first."""
+    factors = [1] * ways
+    remaining = n
+    primes = []
+    d = 2
+    while remaining > 1:
+        while remaining % d == 0:
+            primes.append(d)
+            remaining //= d
+        d += 1
+    for p in sorted(primes, reverse=True):
+        j = int(np.argmin(factors))
+        factors[j] *= p
+    return tuple(sorted(factors, reverse=True))
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              dp: Optional[int] = None, sp: Optional[int] = None,
+              tp: Optional[int] = None) -> Mesh:
+    """Build a ('dp', 'sp', 'tp') mesh over the given (or all) devices.
+
+    Unspecified axis sizes are auto-factorized from the device count.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    known = [a for a in (dp, sp, tp) if a is not None]
+    rest = n // math.prod(known) if known else n
+    if dp is None or sp is None or tp is None:
+        missing = [dp, sp, tp].count(None)
+        auto = list(_factorize(rest, missing))
+        # the node (sp) axis gets the largest auto factor: batch sizes are
+        # often tiny (the denoise example uses 1) while the node axis is
+        # the long one, so defaulting dp large would make default configs
+        # unshardable
+        dims = []
+        for a in (sp, dp, tp):
+            dims.append(a if a is not None else auto.pop(0))
+        sp_d, dp_d, tp_d = dims
+        dims = [dp_d, sp_d, tp_d]
+    else:
+        dims = [dp, sp, tp]
+    assert math.prod(dims) == n, \
+        f'mesh {dims} does not cover {n} devices'
+    mesh_devices = np.asarray(devices).reshape(dims)
+    return Mesh(mesh_devices, MESH_AXES)
+
+
+# canonical partition specs for the data pytree of a training step
+def data_specs() -> dict:
+    return dict(
+        feats=P('dp', 'sp'),          # [b, n] token ids or [b, n, d]
+        coors=P('dp', 'sp', None),    # [b, n, 3]
+        mask=P('dp', 'sp'),           # [b, n]
+        adj_mat=P('dp', 'sp', None),  # [b, n, n]
+        targets=P('dp', 'sp', None),
+    )
+
+
+def shard_batch(batch: dict, mesh: Mesh, leading_axes: int = 0) -> dict:
+    """Place a host batch dict onto the mesh with the canonical specs.
+
+    `leading_axes` extra leading dims (e.g. a gradient-accumulation axis)
+    are left unsharded. Axes that do not divide evenly by their mesh axis
+    fall back to replication for that dimension (e.g. batch_size=1 with
+    dp>1), so any batch is placeable."""
+    specs = data_specs()
+    out = {}
+    for k, v in batch.items():
+        spec = specs.get(k, P('dp'))
+        spec = P(*([None] * leading_axes), *spec)
+        spec = P(*spec[:v.ndim]) if v.ndim < len(spec) else spec
+        fixed = []
+        for d, axis in enumerate(spec):
+            if axis is None:
+                fixed.append(None)
+                continue
+            size = mesh.shape[axis] if isinstance(axis, str) else 1
+            fixed.append(axis if v.shape[d] % size == 0 else None)
+        out[k] = jax.device_put(v, NamedSharding(mesh, P(*fixed)))
+    return out

@@ -1,0 +1,5 @@
+from .denoise import (
+    DenoiseConfig, DenoiseTrainer, denoise_loss_fn, synthetic_protein_batch,
+    chain_adjacency,
+)
+from .checkpoint import CheckpointManager

@@ -1,0 +1,89 @@
+"""Checkpoint / resume (orbax-backed, with a plain-numpy fallback).
+
+The reference has NO model checkpointing (SURVEY.md §5 — denoise.py never
+saves; the only persisted state is the Q_J basis cache). On TPU,
+checkpoint/restore is the recovery story for preemptible slices, so it is
+first-class here: params + optimizer state + step counter, atomic writes,
+latest-checkpoint discovery.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+try:
+    import orbax.checkpoint as ocp
+    _HAS_ORBAX = True
+except Exception:  # pragma: no cover - orbax is in the image, but be safe
+    _HAS_ORBAX = False
+
+
+class CheckpointManager:
+    """Save/restore (params, opt_state, step) under `directory`.
+
+    Uses orbax's StandardCheckpointer when available (async-safe, atomic);
+    otherwise falls back to atomic pickle-of-numpy files. Either way the
+    on-disk layout is step-indexed: <dir>/step_<n>/...
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        os.makedirs(self.directory, exist_ok=True)
+        self._ckptr = ocp.StandardCheckpointer() if _HAS_ORBAX else None
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f'step_{step:08d}')
+
+    def all_steps(self):
+        steps = []
+        for name in os.listdir(self.directory):
+            if name.startswith('step_'):
+                try:
+                    steps.append(int(name[len('step_'):].rstrip('.pkl')))
+                except ValueError:
+                    pass
+        return sorted(set(steps))
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, state: Any):
+        state = jax.device_get(state)
+        if self._ckptr is not None:
+            path = self._step_dir(step)
+            self._ckptr.save(path, state, force=True)
+            self._ckptr.wait_until_finished()
+        else:
+            path = self._step_dir(step) + '.pkl'
+            tmp = path + '.tmp'
+            with open(tmp, 'wb') as f:
+                pickle.dump(state, f)
+            os.replace(tmp, path)
+        self._gc()
+
+    def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f'no checkpoints in {self.directory}')
+        if self._ckptr is not None and os.path.isdir(self._step_dir(step)):
+            target = jax.tree_util.tree_map(np.asarray, jax.device_get(like)) \
+                if like is not None else None
+            return self._ckptr.restore(self._step_dir(step), target)
+        with open(self._step_dir(step) + '.pkl', 'rb') as f:
+            return pickle.load(f)
+
+    def _gc(self):
+        steps = self.all_steps()
+        for step in steps[:-self.max_to_keep]:
+            path = self._step_dir(step)
+            if os.path.isdir(path):
+                import shutil
+                shutil.rmtree(path, ignore_errors=True)
+            elif os.path.exists(path + '.pkl'):
+                os.remove(path + '.pkl')

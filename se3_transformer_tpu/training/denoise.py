@@ -1,0 +1,208 @@
+"""Coordinate-denoising trainer (the reference's flagship application).
+
+TPU-native rework of reference denoise.py (protein-backbone denoising on
+sidechainnet CASP12, /root/reference/denoise.py:1-93): the model predicts a
+type-1 refinement of Gaussian-noised coordinates, trained with masked MSE
+and gradient accumulation. Differences by design:
+
+  * data — sidechainnet is not available offline; `synthetic_protein_batch`
+    generates chain-structured point clouds with the same shapes/adjacency
+    semantics (3 backbone atoms per residue, chain adjacency matrix).
+    Swap in a real dataset by yielding the same batch dict.
+  * precision — the reference runs float64 on CUDA (denoise.py:10); TPUs
+    emulate f64 slowly, so the trainer runs f32 (bf16-matmul optional)
+    which passes the same 1e-4 equivariance budget.
+  * the step is jitted/pjit-able, grad accumulation is a lax.scan, and
+    metrics (nodes*steps/sec/chip) are collected without host sync every
+    step.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..models.se3_transformer import SE3TransformerModule
+from ..native.loader import chain_adjacency
+from ..parallel.mesh import make_mesh, shard_batch
+from ..parallel.sharding import (
+    make_accumulating_train_step, make_sharded_train_step,
+)
+
+
+@dataclasses.dataclass
+class DenoiseConfig:
+    # model (reference denoise.py:22-38 toy config, scaled by BASELINE.json)
+    num_tokens: int = 24
+    dim: int = 8
+    dim_head: int = 8
+    heads: int = 2
+    depth: int = 2
+    num_degrees: int = 2
+    output_degrees: int = 2
+    num_neighbors: int = 0
+    attend_sparse_neighbors: bool = True
+    max_sparse_neighbors: int = 8
+    num_adj_degrees: int = 2
+    adj_dim: int = 4
+    # data
+    batch_size: int = 1
+    num_nodes: int = 96          # 32 residues x 3 backbone atoms
+    noise_scale: float = 1.0
+    # optimization (reference denoise.py:12-13, 51; its example accumulates
+    # 16 micro-batches per update — set accum_steps=16 for parity, the CLI
+    # does so by default)
+    learning_rate: float = 1e-4
+    accum_steps: int = 1
+    # infra
+    seed: int = 0
+    use_mesh: bool = False
+    log_every: int = 1
+
+    def build_module(self) -> SE3TransformerModule:
+        return SE3TransformerModule(
+            num_tokens=self.num_tokens, dim=self.dim, dim_head=self.dim_head,
+            heads=self.heads, depth=self.depth, attend_self=True,
+            input_degrees=1, num_degrees=self.num_degrees,
+            output_degrees=self.output_degrees, reduce_dim_out=True,
+            differentiable_coors=True, num_neighbors=self.num_neighbors,
+            attend_sparse_neighbors=self.attend_sparse_neighbors,
+            max_sparse_neighbors=self.max_sparse_neighbors,
+            num_adj_degrees=self.num_adj_degrees, adj_dim=self.adj_dim)
+
+
+
+
+def synthetic_protein_batch(cfg: DenoiseConfig, rng: np.random.RandomState):
+    """Chain-structured point cloud with residue tokens; mimics the
+    backbone-atom layout of the reference's sidechainnet pipeline."""
+    b, n = cfg.batch_size, cfg.num_nodes
+    seqs = rng.randint(0, cfg.num_tokens, size=(b, n))
+    # random-walk chain coordinates: consecutive atoms ~bond-length apart
+    steps = rng.normal(size=(b, n, 3)).astype(np.float32)
+    steps /= np.linalg.norm(steps, axis=-1, keepdims=True)
+    coords = np.cumsum(1.5 * steps, axis=1).astype(np.float32)
+    coords -= coords.mean(axis=1, keepdims=True)
+    masks = np.ones((b, n), dtype=bool)
+    adj = np.broadcast_to(chain_adjacency(n)[None], (b, n, n)).copy()
+    return dict(seqs=jnp.asarray(seqs),
+                coords=jnp.asarray(coords),
+                masks=jnp.asarray(masks),
+                adj_mat=jnp.asarray(adj))
+
+
+def denoise_loss_fn(module: SE3TransformerModule):
+    """Masked-MSE denoising loss (reference denoise.py:73-89): predict the
+    refinement that maps noised coords back to the clean ones."""
+
+    def loss_fn(params, batch, rng):
+        noise = jax.random.normal(rng, batch['coords'].shape,
+                                  batch['coords'].dtype)
+        noised = batch['coords'] + noise
+        out = module.apply({'params': params}, batch['seqs'], noised,
+                           mask=batch['masks'], adj_mat=batch['adj_mat'],
+                           return_type=1)
+        denoised = noised + out
+        sq = ((denoised - batch['coords']) ** 2).sum(-1)
+        m = batch['masks']
+        loss = jnp.where(m, sq, 0.).sum() / jnp.maximum(m.sum(), 1)
+        return loss, dict(loss=loss)
+
+    return loss_fn
+
+
+class DenoiseTrainer:
+    """End-to-end trainer: init, accumulated+jitted steps, metrics, and
+    (via training.checkpoint) save/restore."""
+
+    def __init__(self, cfg: DenoiseConfig, mesh=None):
+        self.cfg = cfg
+        self.module = cfg.build_module()
+        self.mesh = mesh if mesh is not None else (
+            make_mesh() if cfg.use_mesh else None)
+        self.optimizer = optax.adam(cfg.learning_rate)
+        self.loss_fn = denoise_loss_fn(self.module)
+        if cfg.accum_steps > 1:
+            # reference denoise.py:13,55: 16 micro-batches per update
+            self._step_fn = make_accumulating_train_step(
+                self.loss_fn, self.optimizer, cfg.accum_steps,
+                mesh=self.mesh)
+        else:
+            self._step_fn = make_sharded_train_step(
+                self.loss_fn, self.optimizer, mesh=self.mesh)
+        self.np_rng = np.random.RandomState(cfg.seed)
+        self.rng = jax.random.PRNGKey(cfg.seed)
+        self.params = None
+        self.opt_state = None
+        self.step_count = 0
+
+    def init(self, batch=None):
+        batch = batch if batch is not None else synthetic_protein_batch(
+            self.cfg, self.np_rng)
+        self.rng, sub, noise_rng = jax.random.split(self.rng, 3)
+        noised = batch['coords'] + jax.random.normal(
+            noise_rng, batch['coords'].shape, batch['coords'].dtype)
+        init_fn = jax.jit(self.module.init, static_argnames=('return_type',))
+        self.params = init_fn(
+            sub, batch['seqs'], noised, mask=batch['masks'],
+            adj_mat=batch['adj_mat'], return_type=1)['params']
+        self.opt_state = self.optimizer.init(self.params)
+        return self.params
+
+    def train_step(self, batch) -> float:
+        """One optimizer update. With accum_steps > 1 the batch leaves must
+        carry a leading [accum_steps, ...] axis (see micro_batches)."""
+        if self.params is None:
+            init_batch = batch
+            if self.cfg.accum_steps > 1:
+                init_batch = jax.tree_util.tree_map(lambda v: v[0], batch)
+            self.init(init_batch)
+        if self.mesh is not None:
+            lead = 1 if self.cfg.accum_steps > 1 else 0
+            batch = shard_batch(
+                dict(feats=batch['seqs'], coors=batch['coords'],
+                     mask=batch['masks'], adj_mat=batch['adj_mat']),
+                self.mesh, leading_axes=lead)
+            batch = dict(seqs=batch['feats'], coords=batch['coors'],
+                         masks=batch['mask'], adj_mat=batch['adj_mat'])
+        self.rng, sub = jax.random.split(self.rng)
+        out = self._step_fn(self.params, self.opt_state, batch, sub)
+        if len(out) == 4:
+            self.params, self.opt_state, loss, _ = out
+        else:
+            self.params, self.opt_state, loss = out
+        self.step_count += 1
+        return loss
+
+    def micro_batches(self):
+        """Draw accum_steps micro-batches stacked on a leading axis."""
+        batches = [synthetic_protein_batch(self.cfg, self.np_rng)
+                   for _ in range(max(1, self.cfg.accum_steps))]
+        if self.cfg.accum_steps <= 1:
+            return batches[0]
+        return jax.tree_util.tree_map(
+            lambda *vs: jnp.stack(vs), *batches)
+
+    def train(self, num_steps: int, log=print):
+        """Reference denoise.py:54-93 outer loop, with structured metrics."""
+        history = []
+        t0 = time.time()
+        micro = max(1, self.cfg.accum_steps)
+        for i in range(num_steps):
+            batch = self.micro_batches()
+            loss = self.train_step(batch)
+            if (i + 1) % self.cfg.log_every == 0:
+                loss = float(loss)  # host sync only at log interval
+                dt = time.time() - t0
+                nodes_per_sec = (self.cfg.batch_size * self.cfg.num_nodes
+                                 * micro * (i + 1)) / dt
+                history.append(dict(step=self.step_count, loss=loss,
+                                    nodes_steps_per_sec=nodes_per_sec))
+                log(f'step {self.step_count} loss {loss:.4f} '
+                    f'nodes*steps/sec {nodes_per_sec:.1f}')
+        return history

@@ -1,16 +1,19 @@
 """Test configuration: run on a simulated 8-device CPU mesh with x64 support.
 
-Environment must be set before jax initializes its backends, hence the
-top-of-module os.environ writes.
+XLA_FLAGS must be set before jax initializes its backends, hence the
+top-of-module environ write. The environment pins JAX_PLATFORMS=axon (the
+TPU tunnel) at the wrapper level, so the platform is overridden through
+jax.config, which wins over the env var.
 """
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
+os.environ['JAX_PLATFORMS'] = 'cpu'
 
 import jax  # noqa: E402
 
+jax.config.update('jax_platforms', 'cpu')
 jax.config.update('jax_enable_x64', True)

@@ -1,0 +1,182 @@
+"""End-to-end model tests: every configuration of reference
+tests/test_equivariance.py (all 14), same shapes, same <1e-4 equivariance
+tolerance. The rotation is applied in NumPy float64 on host (TPU/bf16-safe
+methodology; see .claude/skills/verify/SKILL.md)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from se3_transformer_tpu import SE3Transformer
+from se3_transformer_tpu.so3 import rot
+from se3_transformer_tpu.utils import fourier_encode
+
+F32 = jnp.float32
+
+
+def _data(b=1, n=32, d=64, seed=0):
+    rng = np.random.RandomState(seed)
+    feats = jnp.asarray(rng.normal(size=(b, n, d)), F32)
+    coors = jnp.asarray(rng.normal(size=(b, n, 3)), F32)
+    mask = jnp.ones((b, n), bool)
+    return rng, feats, coors, mask
+
+
+def _rotated(coors, R):
+    return jnp.asarray(np.asarray(coors, np.float64) @ R, F32)
+
+
+def _assert_equivariant(model, feats, coors, mask, tol=1e-4, **kwargs):
+    R = rot(15, 0, 45)
+    out1 = model(feats, _rotated(coors, R), mask, return_type=1, **kwargs)
+    out2 = model(feats, coors, mask, return_type=1, **kwargs)
+    out2 = jnp.asarray(np.asarray(out2, np.float64) @ R, out2.dtype)
+    diff = jnp.abs(out1 - out2).max()
+    assert diff < tol, f'is not equivariant: {diff}'
+
+
+def test_transformer():
+    model = SE3Transformer(dim=64, depth=1, num_degrees=2, num_neighbors=4,
+                           valid_radius=10)
+    _, feats, coors, mask = _data()
+    out = model(feats, coors, mask, return_type=0)
+    assert out.shape == (1, 32, 64), 'output must be of the right shape'
+
+
+def test_causal_se3_transformer():
+    model = SE3Transformer(dim=64, depth=1, num_degrees=2, num_neighbors=4,
+                           valid_radius=10, causal=True)
+    _, feats, coors, mask = _data()
+    out = model(feats, coors, mask, return_type=0)
+    assert out.shape == (1, 32, 64)
+
+
+def test_se3_transformer_with_global_nodes():
+    model = SE3Transformer(dim=64, depth=1, num_degrees=2, num_neighbors=4,
+                           valid_radius=10, global_feats_dim=16)
+    rng, feats, coors, mask = _data()
+    global_feats = jnp.asarray(rng.normal(size=(1, 2, 16)), F32)
+    out = model(feats, coors, mask, return_type=0, global_feats=global_feats)
+    assert out.shape == (1, 32, 64)
+
+
+def test_one_headed_key_values_se3_transformer_with_global_nodes():
+    model = SE3Transformer(dim=64, depth=1, num_degrees=2, num_neighbors=4,
+                           valid_radius=10, global_feats_dim=16,
+                           one_headed_key_values=True)
+    rng, feats, coors, mask = _data()
+    global_feats = jnp.asarray(rng.normal(size=(1, 2, 16)), F32)
+    out = model(feats, coors, mask, return_type=0, global_feats=global_feats)
+    assert out.shape == (1, 32, 64)
+
+
+def test_transformer_with_edges():
+    model = SE3Transformer(dim=64, depth=1, num_degrees=2, num_neighbors=4,
+                           edge_dim=4, num_edge_tokens=4)
+    rng, feats, coors, mask = _data()
+    edges = jnp.asarray(rng.randint(0, 4, (1, 32)), jnp.int32)
+    edges = jnp.broadcast_to(edges[:, :, None], (1, 32, 32))
+    out = model(feats, coors, mask, edges=edges, return_type=0)
+    assert out.shape == (1, 32, 64)
+
+
+def test_transformer_with_continuous_edges():
+    model = SE3Transformer(dim=64, depth=1, attend_self=True, num_degrees=2,
+                           output_degrees=2, edge_dim=34)
+    rng, feats, coors, mask = _data()
+    pairwise_continuous_values = jnp.asarray(
+        rng.randint(0, 4, (1, 32, 32, 2)), F32)
+    edges = fourier_encode(pairwise_continuous_values, num_encodings=8,
+                           include_self=True)
+    out = model(feats, coors, mask, edges=edges, return_type=1)
+    assert out.shape == (1, 32, 64, 3)
+
+
+def test_different_input_dimensions_for_types():
+    model = SE3Transformer(dim_in=(4, 2), dim=4, depth=1, input_degrees=2,
+                           num_degrees=2, output_degrees=2,
+                           reduce_dim_out=True)
+    rng = np.random.RandomState(0)
+    atom_feats = jnp.asarray(rng.normal(size=(2, 32, 4, 1)), F32)
+    coors_feats = jnp.asarray(rng.normal(size=(2, 32, 2, 3)), F32)
+    features = {'0': atom_feats, '1': coors_feats}
+    coors = jnp.asarray(rng.normal(size=(2, 32, 3)), F32)
+    mask = jnp.ones((2, 32), bool)
+    refined = coors + model(features, coors, mask, return_type=1)
+    assert refined.shape == (2, 32, 3)
+
+
+def test_equivariance():
+    model = SE3Transformer(dim=64, depth=1, attend_self=True,
+                           num_neighbors=4, num_degrees=2, output_degrees=2,
+                           fourier_encode_dist=True)
+    _, feats, coors, mask = _data()
+    _assert_equivariant(model, feats, coors, mask)
+
+
+def test_equivariance_with_egnn_backbone():
+    model = SE3Transformer(dim=64, depth=1, attend_self=True,
+                           num_neighbors=4, num_degrees=2, output_degrees=2,
+                           fourier_encode_dist=True, use_egnn=True)
+    _, feats, coors, mask = _data()
+    _assert_equivariant(model, feats, coors, mask)
+
+
+def test_rotary():
+    model = SE3Transformer(dim=64, depth=1, attend_self=True,
+                           num_neighbors=4, num_degrees=2, output_degrees=2,
+                           fourier_encode_dist=True, rotary_position=True,
+                           rotary_rel_dist=True)
+    _, feats, coors, mask = _data()
+    _assert_equivariant(model, feats, coors, mask)
+
+
+def test_equivariance_linear_proj_keys():
+    model = SE3Transformer(dim=64, depth=1, attend_self=True,
+                           num_neighbors=4, num_degrees=2, output_degrees=2,
+                           fourier_encode_dist=True, linear_proj_keys=True)
+    _, feats, coors, mask = _data()
+    _assert_equivariant(model, feats, coors, mask)
+
+
+def test_equivariance_only_sparse_neighbors():
+    # float64 in the reference (test_equivariance.py:234); we keep float32
+    # inputs but the CPU x64 test env makes intermediate promotion harmless
+    model = SE3Transformer(dim=64, depth=1, attend_self=True, num_degrees=2,
+                           output_degrees=2, num_neighbors=0,
+                           attend_sparse_neighbors=True, num_adj_degrees=2,
+                           adj_dim=4)
+    _, feats, coors, mask = _data()
+    seq = np.arange(32)
+    adj_mat = (seq[:, None] >= (seq[None, :] - 1)) & \
+              (seq[:, None] <= (seq[None, :] + 1))
+    _assert_equivariant(model, feats, coors, mask,
+                        adj_mat=jnp.asarray(adj_mat))
+
+
+def test_equivariance_with_reversible_network():
+    model = SE3Transformer(dim=64, depth=1, attend_self=True,
+                           num_neighbors=4, num_degrees=2, output_degrees=2,
+                           reversible=True)
+    _, feats, coors, mask = _data()
+    _assert_equivariant(model, feats, coors, mask)
+
+
+def test_equivariance_with_type_one_input():
+    model = SE3Transformer(dim=64, depth=1, attend_self=True,
+                           num_neighbors=4, num_degrees=2, input_degrees=2,
+                           output_degrees=2)
+    rng = np.random.RandomState(0)
+    atom_features = jnp.asarray(rng.normal(size=(1, 32, 64, 1)), F32)
+    pred_coors = jnp.asarray(rng.normal(size=(1, 32, 64, 3)), F32)
+    coors = jnp.asarray(rng.normal(size=(1, 32, 3)), F32)
+    mask = jnp.ones((1, 32), bool)
+
+    R = rot(15, 0, 45)
+    rot_f32 = lambda t: jnp.asarray(np.asarray(t, np.float64) @ R, F32)
+    out1 = model({'0': atom_features, '1': rot_f32(pred_coors)},
+                 rot_f32(coors), mask, return_type=1)
+    out2 = model({'0': atom_features, '1': pred_coors}, coors, mask,
+                 return_type=1)
+    out2 = jnp.asarray(np.asarray(out2, np.float64) @ R, F32)
+    diff = jnp.abs(out1 - out2).max()
+    assert diff < 1e-4, f'is not equivariant: {diff}'

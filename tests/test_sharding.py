@@ -1,0 +1,58 @@
+"""Multi-device SPMD tests on the simulated 8-device CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from se3_transformer_tpu.parallel import make_mesh, shard_batch
+from se3_transformer_tpu.training import (
+    DenoiseConfig, DenoiseTrainer, synthetic_protein_batch,
+)
+
+
+def test_mesh_factorization():
+    assert len(jax.devices()) == 8, 'conftest must provide 8 CPU devices'
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ('dp', 'sp', 'tp')
+
+    mesh2 = make_mesh(dp=4, sp=2, tp=1)
+    assert mesh2.devices.shape == (4, 2, 1)
+
+
+def test_sharded_train_step_matches_single_device():
+    cfg = DenoiseConfig(num_nodes=24, batch_size=4, num_degrees=2,
+                        max_sparse_neighbors=4, seed=3)
+    batch = synthetic_protein_batch(cfg, np.random.RandomState(0))
+
+    single = DenoiseTrainer(cfg)
+    loss_single = float(single.train_step(batch))
+
+    mesh = make_mesh(dp=4, sp=2, tp=1)
+    sharded = DenoiseTrainer(cfg, mesh=mesh)
+    loss_sharded = float(sharded.train_step(batch))
+
+    assert np.isfinite(loss_single) and np.isfinite(loss_sharded)
+    assert abs(loss_single - loss_sharded) < 1e-3 * max(1.0, abs(loss_single))
+
+    # params after one step agree too (same rng path, same data)
+    flat1 = jax.tree_util.tree_leaves(single.params)
+    flat2 = jax.tree_util.tree_leaves(sharded.params)
+    for a, b in zip(flat1, flat2):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_graft_entry_dryrun():
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
+
+
+def test_shard_batch_placement():
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    batch = dict(feats=jnp.zeros((4, 16)), coors=jnp.zeros((4, 16, 3)),
+                 mask=jnp.ones((4, 16), bool))
+    placed = shard_batch(batch, mesh)
+    for v in placed.values():
+        assert len(v.sharding.device_set) in (4, 8)

@@ -1,0 +1,72 @@
+"""Training-slice tests: denoise trainer runs and learns; checkpoint
+roundtrip; gradient accumulation."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from se3_transformer_tpu.training import (
+    CheckpointManager, DenoiseConfig, DenoiseTrainer,
+    synthetic_protein_batch,
+)
+
+
+def test_denoise_trainer_runs_and_loss_finite(tmp_path):
+    cfg = DenoiseConfig(num_nodes=24, batch_size=2, num_degrees=2,
+                        max_sparse_neighbors=4, learning_rate=1e-3)
+    trainer = DenoiseTrainer(cfg)
+    history = trainer.train(3, log=lambda *_: None)
+    losses = [h['loss'] for h in history]
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    cfg = DenoiseConfig(num_nodes=16, batch_size=1, num_degrees=2,
+                        max_sparse_neighbors=4)
+    trainer = DenoiseTrainer(cfg)
+    batch = synthetic_protein_batch(cfg, np.random.RandomState(0))
+    trainer.train_step(batch)
+
+    mgr = CheckpointManager(os.path.join(tmp_path, 'ckpt'))
+    mgr.save(trainer.step_count, (trainer.params, trainer.opt_state,
+                                  trainer.step_count))
+    assert mgr.latest_step() == trainer.step_count
+
+    restored = mgr.restore(like=(trainer.params, trainer.opt_state,
+                                 trainer.step_count))
+    r_params = restored[0]
+    for a, b in zip(jax.tree_util.tree_leaves(trainer.params),
+                    jax.tree_util.tree_leaves(r_params)):
+        assert np.allclose(np.asarray(a), np.asarray(b))
+
+    # training continues from the restored state
+    trainer.params = r_params
+    loss = trainer.train_step(batch)
+    assert np.isfinite(float(loss))
+
+
+def test_checkpoint_gc(tmp_path):
+    mgr = CheckpointManager(os.path.join(tmp_path, 'ckpt'), max_to_keep=2)
+    for s in (1, 2, 3, 4):
+        mgr.save(s, {'x': jnp.ones(3) * s})
+    assert mgr.all_steps() == [3, 4]
+
+
+def test_accumulating_step():
+    import optax
+    from se3_transformer_tpu.parallel import make_accumulating_train_step
+
+    def loss_fn(params, batch, rng):
+        pred = batch['x'] * params['w']
+        return ((pred - batch['y']) ** 2).mean(), {}
+
+    params = {'w': jnp.asarray(0.0)}
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(params)
+    step = make_accumulating_train_step(loss_fn, opt, accum_steps=4)
+    batch = {'x': jnp.ones((4, 8)), 'y': 2 * jnp.ones((4, 8))}
+    params, opt_state, loss = step(params, opt_state, batch,
+                                   jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
+    assert float(params['w']) > 0  # moved toward y/x = 2

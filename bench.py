@@ -7,49 +7,99 @@ kNN neighbors) in a denoise.py-scale model. The reference publishes no
 benchmark numbers (BASELINE.md: "published": {}), so vs_baseline is
 reported against this repo's own first recorded value (RECORD below);
 1.0 until a prior record exists.
+
+All heavy imports happen inside main() so the multiprocessing spawn child
+used by the device probe only sees function definitions.
 """
 import json
+import multiprocessing
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-
-from se3_transformer_tpu.models.se3_transformer import SE3TransformerModule
-from se3_transformer_tpu.parallel.sharding import make_sharded_train_step
 
 # first recorded nodes*steps/sec/chip on TPU v5e-1 (update as it improves)
 RECORD = None
 
-NUM_NODES = 1024
-NUM_DEGREES = 4
-BATCH = 1
-NUM_NEIGHBORS = 32
-STEPS = 20
+
+def _probe_device(q):
+    try:
+        import jax
+        q.put(jax.default_backend())
+    except Exception:
+        q.put('error')
 
 
-def main():
+def _device_backend_or_cpu(timeout_s: int = 120) -> str:
+    """The axon TPU tunnel is single-client and can wedge (hang at backend
+    init) if a previous holder died; probe it in a subprocess so the bench
+    always completes, falling back to CPU with an honest metric label."""
+    ctx = multiprocessing.get_context('spawn')
+    q = ctx.Queue()
+    p = ctx.Process(target=_probe_device, args=(q,))
+    p.start()
+    p.join(timeout_s)
+    if p.is_alive():
+        # a child wedged inside the tunnel's backend init can ignore
+        # SIGTERM — escalate to SIGKILL rather than joining forever
+        p.terminate()
+        p.join(10)
+        if p.is_alive():
+            p.kill()
+            p.join(10)
+        return 'cpu'
+    try:
+        backend = q.get(timeout=5)
+    except Exception:
+        return 'cpu'
+    return backend if backend in ('tpu',) else 'cpu'
+
+
+def main(backend: str):
+    import jax
+
+    if backend != 'tpu':
+        # NOTE: setting the JAX_PLATFORMS env var here is too late — the
+        # environment's sitecustomize imports jax internals at interpreter
+        # startup, freezing the env-derived config. Only the config.update
+        # path actually switches the platform.
+        jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from se3_transformer_tpu.models.se3_transformer import SE3TransformerModule
+    from se3_transformer_tpu.parallel.sharding import make_sharded_train_step
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+
+    if backend == 'tpu':
+        num_nodes, num_degrees, batch, num_neighbors, steps = 1024, 4, 1, 32, 20
+    else:
+        # liveness fallback only (wedged/absent TPU): tiny config so the
+        # bench still completes and is honestly labelled backend=cpu
+        num_nodes, num_degrees, batch, num_neighbors, steps = 128, 2, 1, 8, 3
+
     module = SE3TransformerModule(
         num_tokens=24, dim=8, dim_head=8, heads=2, depth=2,
-        attend_self=True, input_degrees=1, num_degrees=NUM_DEGREES,
+        attend_self=True, input_degrees=1, num_degrees=num_degrees,
         output_degrees=2, reduce_dim_out=True, differentiable_coors=True,
-        num_neighbors=NUM_NEIGHBORS)
+        num_neighbors=num_neighbors)
 
     rng = np.random.RandomState(0)
-    seqs = jnp.asarray(rng.randint(0, 24, (BATCH, NUM_NODES)))
+    seqs = jnp.asarray(rng.randint(0, 24, (batch, num_nodes)))
     coords = jnp.asarray(np.cumsum(
-        rng.normal(size=(BATCH, NUM_NODES, 3)), axis=1), jnp.float32)
+        rng.normal(size=(batch, num_nodes, 3)), axis=1), jnp.float32)
     coords = coords - coords.mean(axis=1, keepdims=True)
-    masks = jnp.ones((BATCH, NUM_NODES), bool)
+    masks = jnp.ones((batch, num_nodes), bool)
 
-    def loss_fn(params, batch, key):
-        noise = jax.random.normal(key, batch['coords'].shape,
-                                  batch['coords'].dtype)
-        noised = batch['coords'] + noise
-        out = module.apply({'params': params}, batch['seqs'], noised,
-                           mask=batch['masks'], return_type=1)
-        loss = (((noised + out) - batch['coords']) ** 2).sum(-1).mean()
+    def loss_fn(params, data, key):
+        noise = jax.random.normal(key, data['coords'].shape,
+                                  data['coords'].dtype)
+        noised = data['coords'] + noise
+        out = module.apply({'params': params}, data['seqs'], noised,
+                           mask=data['masks'], return_type=1)
+        loss = (((noised + out) - data['coords']) ** 2).sum(-1).mean()
         return loss, dict()
 
     # jit the init: eager init would dispatch thousands of tiny ops through
@@ -61,30 +111,32 @@ def main():
     opt_state = optimizer.init(params)
     step = make_sharded_train_step(loss_fn, optimizer)
 
-    batch = dict(seqs=seqs, coords=coords, masks=masks)
+    data = dict(seqs=seqs, coords=coords, masks=masks)
     key = jax.random.PRNGKey(1)
 
     # compile + warmup
-    params, opt_state, loss, _ = step(params, opt_state, batch, key)
+    params, opt_state, loss, _ = step(params, opt_state, data, key)
     jax.block_until_ready(loss)
 
     t0 = time.time()
-    for i in range(STEPS):
+    for _ in range(steps):
         key, sub = jax.random.split(key)
-        params, opt_state, loss, _ = step(params, opt_state, batch, sub)
+        params, opt_state, loss, _ = step(params, opt_state, data, sub)
     jax.block_until_ready(loss)
     dt = time.time() - t0
 
-    nodes_steps_per_sec = BATCH * NUM_NODES * STEPS / dt
+    nodes_steps_per_sec = batch * num_nodes * steps / dt
     vs = nodes_steps_per_sec / RECORD if RECORD else 1.0
+    actual = jax.default_backend()
     print(json.dumps({
         'metric': f'denoise_train_nodes_steps_per_sec_per_chip'
-                  f'(n={NUM_NODES},deg={NUM_DEGREES},k={NUM_NEIGHBORS})',
+                  f'(n={num_nodes},deg={num_degrees},k={num_neighbors},'
+                  f'backend={actual})',
         'value': round(nodes_steps_per_sec, 2),
-        'unit': 'nodes*steps/sec/chip',
+        'unit': f'nodes*steps/sec/{"chip" if actual == "tpu" else "cpu-host"}',
         'vs_baseline': round(vs, 3),
     }))
 
 
 if __name__ == '__main__':
-    main()
+    main(_device_backend_or_cpu())

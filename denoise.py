@@ -11,6 +11,9 @@ point). The model/optimization hyperparameters mirror the reference
 """
 import argparse
 
+from se3_transformer_tpu.utils.compilation_cache import enable_compilation_cache
+enable_compilation_cache()
+
 from se3_transformer_tpu.training import DenoiseConfig, DenoiseTrainer
 from se3_transformer_tpu.training.checkpoint import CheckpointManager
 from se3_transformer_tpu.utils.observability import MetricLogger

@@ -109,6 +109,12 @@ class SE3TransformerModule(nn.Module):
     out_fiber_dict: Optional[Dict[int, int]] = None
     # None -> auto (Pallas fused pairwise kernel on TPU, XLA elsewhere)
     pallas: Optional[bool] = None
+    # matmul precision policy: None = backend default (bf16 MXU on TPU,
+    # fastest), 'float32'/'highest' = strict (equivariance < 1e-4 on TPU;
+    # see scripts/tpu_checks.py). The basis itself is always full precision.
+    matmul_precision: Optional[str] = None
+    # share one radial hidden trunk across degree pairs (perf option)
+    shared_radial_hidden: bool = False
 
     # ------------------------------------------------------------------ #
     # static configuration helpers (resolved at trace time)
@@ -143,6 +149,16 @@ class SE3TransformerModule(nn.Module):
     def __call__(self, feats, coors, mask=None, adj_mat=None, edges=None,
                  return_type=None, return_pooled=False, neighbor_mask=None,
                  global_feats=None):
+        if self.matmul_precision is not None:
+            with jax.default_matmul_precision(self.matmul_precision):
+                return self._forward(
+                    feats, coors, mask, adj_mat, edges, return_type,
+                    return_pooled, neighbor_mask, global_feats)
+        return self._forward(feats, coors, mask, adj_mat, edges, return_type,
+                             return_pooled, neighbor_mask, global_feats)
+
+    def _forward(self, feats, coors, mask, adj_mat, edges, return_type,
+                 return_pooled, neighbor_mask, global_feats):
         num_degrees, fiber_in, fiber_hidden, fiber_out, output_degrees = \
             self._resolved()
 
@@ -269,7 +285,8 @@ class SE3TransformerModule(nn.Module):
             edge_dim=(edges.shape[-1] if edges is not None else 0),
             fourier_encode_dist=self.fourier_encode_dist,
             num_fourier_features=self.rel_dist_num_fourier_features,
-            pallas=self.pallas)
+            pallas=self.pallas,
+            shared_radial_hidden=self.shared_radial_hidden)
 
         # project in + pre-convs (reference :1338-1344)
         x = ConvSE3(fiber_in, fiber_hidden, name='conv_in', **conv_kwargs)(
@@ -382,7 +399,8 @@ class SE3TransformerModule(nn.Module):
             tie_key_values=self.tie_key_values,
             one_headed_key_values=self.one_headed_key_values,
             norm_gated_scale=self.norm_gated_scale,
-            reversible=self.reversible, pallas=self.pallas, name='trunk')(
+            reversible=self.reversible, pallas=self.pallas,
+            shared_radial_hidden=self.shared_radial_hidden, name='trunk')(
                 x, edge_info, rel_dist, basis, global_feats, pos_emb, mask)
 
 

@@ -41,6 +41,7 @@ class AttentionSE3(nn.Module):
     linear_proj_keys: bool = False
     tie_key_values: bool = False
     pallas: Optional[bool] = None
+    shared_radial_hidden: bool = False
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -66,7 +67,8 @@ class AttentionSE3(nn.Module):
             edge_dim=self.edge_dim or 0,
             fourier_encode_dist=self.fourier_encode_dist,
             num_fourier_features=self.rel_dist_num_fourier_features,
-            pallas=self.pallas)
+            pallas=self.pallas,
+            shared_radial_hidden=self.shared_radial_hidden)
 
         queries = LinearSE3(self.fiber, hidden_fiber, name='to_q')(features)
         values = ConvSE3(self.fiber, kv_fiber, name='to_v', **conv_kwargs)(
@@ -193,6 +195,7 @@ class AttentionBlockSE3(nn.Module):
     one_headed_key_values: bool = False
     norm_gated_scale: bool = False
     pallas: Optional[bool] = None
+    shared_radial_hidden: bool = False
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -213,6 +216,7 @@ class AttentionBlockSE3(nn.Module):
             linear_proj_keys=self.linear_proj_keys,
             tie_key_values=self.tie_key_values,
             pallas=self.pallas,
+            shared_radial_hidden=self.shared_radial_hidden,
             name='attn')(out, edge_info, rel_dist, basis, global_feats,
                          pos_emb, mask)
         return residual_se3(out, res)

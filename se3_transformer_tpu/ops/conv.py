@@ -41,6 +41,9 @@ Features = Dict[str, jnp.ndarray]
 #              edges [b,n,k,e] | None)
 EdgeInfo = Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]
 
+# radial-MLP hidden width (reference RadialFunc mid_dim, :283)
+DEFAULT_MID_DIM = 128
+
 
 class RadialFunc(nn.Module):
     """Per-edge radial profile MLP (reference :270-299).
@@ -53,7 +56,7 @@ class RadialFunc(nn.Module):
     in_dim: int
     out_dim: int
     edge_dim: int = 0
-    mid_dim: int = 128
+    mid_dim: int = DEFAULT_MID_DIM
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -111,26 +114,29 @@ class PairwiseConvSE3(nn.Module):
     nc_in: int
     degree_out: int
     nc_out: int
-    mid_dim: int = 128
+    mid_dim: int = DEFAULT_MID_DIM
     pallas: Optional[bool] = None
     pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, edge_feats: jnp.ndarray, basis_slice: jnp.ndarray,
-                 x: jnp.ndarray) -> jnp.ndarray:
+                 x: jnp.ndarray,
+                 hidden: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         """edge_feats [b,n,k,e]; basis_slice [b,n,k,P,Q,F]; x [b,n,k,c_in,Q]
-        -> [b,n,k,c_out,P]"""
+        -> [b,n,k,c_out,P]. `hidden` supplies a precomputed (shared) radial
+        trunk activation [b,n,k,mid] (see ConvSE3.shared_radial_hidden)."""
         F = to_order(min(self.degree_in, self.degree_out))
         P = to_order(self.degree_out)
         IF = self.nc_in * F
 
-        h = radial_hidden(edge_feats, self.mid_dim)          # [b,n,k,mid]
+        h = hidden if hidden is not None \
+            else radial_hidden(edge_feats, self.mid_dim)     # [b,n,k,mid]
 
         w3 = self.param(
             'w3',
             nn.initializers.variance_scaling(1.0, 'fan_in', 'truncated_normal',
                                              in_axis=0, out_axis=(1, 2)),
-            (self.mid_dim, IF, self.nc_out), h.dtype)
+            (h.shape[-1], IF, self.nc_out), h.dtype)
         b3 = self.param('b3', nn.initializers.zeros, (IF, self.nc_out),
                         h.dtype)
 
@@ -147,7 +153,7 @@ class PairwiseConvSE3(nn.Module):
             E = 1
             for s in lead:
                 E *= s
-            h2 = h.reshape(E, self.mid_dim)
+            h2 = h.reshape(E, h.shape[-1])
             v22 = v2.reshape(E, P, IF)
             # fold bias: ones column on h, bias row on w3
             h2 = jnp.concatenate(
@@ -184,6 +190,10 @@ class ConvSE3(nn.Module):
     num_fourier_features: int = 4
     pallas: Optional[bool] = None
     pallas_interpret: bool = False
+    # share one radial hidden trunk across all degree pairs (perf option;
+    # the reference uses an independent MLP per pair, which dominates FLOPs
+    # at small channel counts — parameterization differs when enabled)
+    shared_radial_hidden: bool = False
 
     @nn.compact
     def __call__(self, inp: Features, edge_info: EdgeInfo,
@@ -207,6 +217,9 @@ class ConvSE3(nn.Module):
             gathered[key] = batched_index_select(
                 inp[key], neighbor_indices, axis=1)  # [b, n, k, c_in, 2di+1]
 
+        hidden = radial_hidden(edge_features, DEFAULT_MID_DIM) \
+            if self.shared_radial_hidden else None
+
         outputs = {}
         for degree_out, m_out in self.fiber_out:
             acc = None
@@ -218,7 +231,8 @@ class ConvSE3(nn.Module):
                     name=f'pair_{degree_in}_{degree_out}')(
                         edge_features,
                         basis[f'{degree_in},{degree_out}'],
-                        gathered[str(degree_in)])
+                        gathered[str(degree_in)],
+                        hidden=hidden)
                 acc = y if acc is None else acc + y
 
             if self.pool:

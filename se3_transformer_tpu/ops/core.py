@@ -16,6 +16,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..utils.helpers import safe_norm
 from .fiber import Fiber
 
 
@@ -70,8 +71,8 @@ class NormSE3(nn.Module):
         output = {}
         for degree, t in features.items():
             chan = t.shape[-2]
-            norm = jnp.linalg.norm(t, axis=-1, keepdims=True)
-            norm = jnp.clip(norm, self.eps, None)
+            norm = jnp.clip(safe_norm(t, axis=-1, keepdims=True),
+                            self.eps, None)
             phase = t / norm
 
             scalars = norm[..., 0]  # [..., c]

@@ -21,7 +21,7 @@ from typing import Dict, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from ..utils.helpers import batched_index_select, broadcat
+from ..utils.helpers import batched_index_select, broadcat, safe_norm
 from .conv import EdgeInfo
 from .core import FeedForwardBlockSE3
 from .fiber import Fiber
@@ -52,7 +52,7 @@ class HtypesNorm(nn.Module):
         bias = self.param('bias',
                           nn.initializers.constant(self.bias_init),
                           (self.dim, 1), htype.dtype)
-        norm = jnp.linalg.norm(htype, axis=-1, keepdims=True)
+        norm = safe_norm(htype, axis=-1, keepdims=True)
         normed = htype / jnp.clip(norm, self.eps, None)
         return normed * (norm * scale + bias)
 
@@ -85,7 +85,7 @@ class EGNN(nn.Module):
             nbr = batched_index_select(htype, neighbor_indices, axis=1)
             rel = htype[:, :, None] - nbr            # [b, n, k, c, m]
             rel_htypes[degree] = rel
-            rel_htype_dists.append(jnp.linalg.norm(rel, axis=-1))
+            rel_htype_dists.append(safe_norm(rel, axis=-1))
 
         nodes_i = nodes[:, :, None]                   # [b, n, 1, d]
         nodes_j = batched_index_select(nodes, neighbor_indices, axis=1)

@@ -19,7 +19,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..utils.helpers import batched_index_select
+from ..utils.helpers import batched_index_select, safe_norm
 
 FINF = float(jnp.finfo(jnp.float32).max)
 
@@ -102,7 +102,7 @@ def select_neighbors(
     unmodified distance is what downstream layers consume.
     """
     b, n = rel_pos.shape[0], rel_pos.shape[1]
-    rel_dist = jnp.linalg.norm(rel_pos, axis=-1)  # [b, n, n-1]
+    rel_dist = safe_norm(rel_pos, axis=-1)  # [b, n, n-1]
 
     ranking = rel_dist
     if neighbor_mask is not None:

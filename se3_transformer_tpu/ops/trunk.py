@@ -48,6 +48,7 @@ class SequentialTrunk(nn.Module):
     norm_gated_scale: bool = False
     reversible: bool = False
     pallas: Optional[bool] = None
+    shared_radial_hidden: bool = False
 
     @nn.compact
     def __call__(self, x: Features, edge_info, rel_dist, basis,
@@ -70,6 +71,7 @@ class SequentialTrunk(nn.Module):
                 one_headed_key_values=self.one_headed_key_values,
                 norm_gated_scale=self.norm_gated_scale,
                 pallas=self.pallas,
+                shared_radial_hidden=self.shared_radial_hidden,
                 name=f'attn_block{i}')(
                     x, edge_info, rel_dist, basis, global_feats, pos_emb,
                     mask)

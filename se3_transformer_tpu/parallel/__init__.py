@@ -1,4 +1,6 @@
 from .mesh import make_mesh, shard_batch, data_specs, MESH_AXES
+from . import distributed
+from .ring import ring_knn, dense_knn
 from .sharding import (
     make_sharded_train_step, make_accumulating_train_step, replicated,
 )

@@ -1,0 +1,65 @@
+"""Multi-host initialization and cross-slice mesh construction.
+
+The reference has no distributed story (SURVEY.md §2.9). On TPU pods the
+runtime is jax.distributed + GSPMD collectives: ICI within a slice, DCN
+across slices. This module is the thin, idiomatic entry:
+
+    from se3_transformer_tpu.parallel import distributed
+    distributed.initialize()            # no-op on a single host
+    mesh = distributed.pod_mesh(dp=..., sp=..., tp=...)
+
+`pod_mesh` orders devices so the sp/tp axes map onto ICI neighbors
+(`jax.experimental.mesh_utils.create_device_mesh`); with multiple slices
+it uses `create_hybrid_device_mesh` so dp rides DCN (the
+bandwidth-tolerant axis) and sp/tp stay on ICI.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from .mesh import MESH_AXES, make_mesh
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """jax.distributed.initialize with env fallbacks; returns True if a
+    multi-process runtime was initialized (no-op for single host)."""
+    num_processes = num_processes if num_processes is not None else int(
+        os.environ.get('SE3_TPU_NUM_PROCESSES', '1'))
+    if num_processes <= 1 and coordinator_address is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    return True
+
+
+def pod_mesh(dp: Optional[int] = None, sp: Optional[int] = None,
+             tp: Optional[int] = None):
+    """Mesh over all global devices with ICI-friendly ordering.
+
+    Uses mesh_utils.create_device_mesh (hybrid variant across slices, so
+    dp rides DCN); falls back to the plain reshape mesh when the physical
+    topology is unknown (CPU simulation)."""
+    devices = jax.devices()
+    base = make_mesh(devices, dp=dp, sp=sp, tp=tp)  # resolves axis sizes
+    dims = [base.shape[a] for a in MESH_AXES]
+    slice_ids = sorted({getattr(d, 'slice_index', 0) for d in devices})
+    n_slices = len(slice_ids)
+    from jax.experimental import mesh_utils
+    try:
+        if n_slices > 1 and dims[0] % n_slices == 0:
+            arr = mesh_utils.create_hybrid_device_mesh(
+                [dims[0] // n_slices, dims[1], dims[2]],
+                dcn_mesh_shape=[n_slices, 1, 1], devices=devices)
+        else:
+            arr = mesh_utils.create_device_mesh(dims, devices=devices)
+        return jax.sharding.Mesh(arr, MESH_AXES)
+    except (ValueError, AssertionError, NotImplementedError):
+        # unknown/irregular topology (e.g. simulated CPU devices)
+        return base

@@ -1,0 +1,23 @@
+"""Persistent XLA compilation cache.
+
+First compiles through the TPU tunnel take tens of seconds to minutes;
+the driver and users re-run the same shapes constantly. Enabling JAX's
+persistent compilation cache makes every process after the first start
+hot. Called by bench.py, denoise.py and the graft entry points; users can
+call it once at program start.
+"""
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache(path: str | None = None) -> str:
+    import jax
+
+    path = path or os.environ.get(
+        'SE3_TPU_JIT_CACHE',
+        os.path.expanduser('~/.cache/se3_transformer_tpu/jit'))
+    os.makedirs(path, exist_ok=True)
+    jax.config.update('jax_compilation_cache_dir', path)
+    jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+    return path

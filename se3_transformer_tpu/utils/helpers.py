@@ -131,3 +131,17 @@ def benchmark(fn):
 
 def masked_fill(tensor, mask, value):
     return jnp.where(mask, jnp.asarray(value, dtype=tensor.dtype), tensor)
+
+
+def safe_norm(x: jnp.ndarray, axis: int = -1, keepdims: bool = False):
+    """L2 norm with a well-defined (zero) gradient at x = 0.
+
+    jnp.linalg.norm's gradient at 0 is NaN; torch subgradients to 0 there.
+    Exactly-zero vectors occur structurally (EGNN self-loops, padded
+    neighbors), so use the double-where trick: the forward value is exact,
+    the 0-branch blocks the NaN cotangent.
+    """
+    sq = jnp.sum(x * x, axis=axis, keepdims=keepdims)
+    is_zero = sq == 0
+    safe = jnp.sqrt(jnp.where(is_zero, 1.0, sq))
+    return jnp.where(is_zero, 0.0, safe)

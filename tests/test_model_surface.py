@@ -1,0 +1,150 @@
+"""Coverage of the remaining constructor/forward surface beyond the 14
+ported reference configs: fiber dicts, pooled returns, pre-convs, positions,
+norm_out, null-kv, tied keys, causal information flow, neighbor_mask arg,
+EGNN options."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from se3_transformer_tpu import SE3Transformer
+from se3_transformer_tpu.so3 import rot
+
+F32 = jnp.float32
+
+
+def _data(b=1, n=16, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    feats = jnp.asarray(rng.normal(size=(b, n, d)), F32)
+    coors = jnp.asarray(rng.normal(size=(b, n, 3)), F32)
+    mask = jnp.ones((b, n), bool)
+    return rng, feats, coors, mask
+
+
+def test_hidden_and_out_fiber_dicts():
+    model = SE3Transformer(dim=8, depth=1, num_neighbors=4,
+                           hidden_fiber_dict={0: 8, 1: 4, 2: 2},
+                           out_fiber_dict={0: 6, 1: 3})
+    _, feats, coors, mask = _data()
+    out = model(feats, coors, mask)
+    assert out['0'].shape == (1, 16, 6)
+    assert out['1'].shape == (1, 16, 3, 3)
+
+
+def test_return_pooled():
+    model = SE3Transformer(dim=8, depth=1, num_degrees=2, output_degrees=2,
+                           num_neighbors=4)
+    _, feats, coors, mask = _data()
+    out = model(feats, coors, mask, return_pooled=True)
+    assert out['0'].shape == (1, 8)
+    assert out['1'].shape == (1, 8, 3)
+
+
+def test_norm_out_and_preconv_layers():
+    model = SE3Transformer(dim=8, depth=1, num_degrees=2, num_neighbors=4,
+                           norm_out=True, num_conv_layers=2)
+    _, feats, coors, mask = _data()
+    out = model(feats, coors, mask, return_type=0)
+    assert out.shape == (1, 16, 8)
+
+
+def test_num_positions_embedding():
+    model = SE3Transformer(dim=8, depth=1, num_degrees=2, num_neighbors=4,
+                           num_tokens=12, num_positions=32)
+    rng, _, coors, mask = _data()
+    tokens = jnp.asarray(rng.randint(0, 12, (1, 16)))
+    out = model(tokens, coors, mask, return_type=0)
+    assert out.shape == (1, 16, 8)
+
+
+def test_null_kv_and_tie_key_values_equivariance():
+    for kwargs in (dict(use_null_kv=True), dict(tie_key_values=True),
+                   dict(one_headed_key_values=True, use_null_kv=True)):
+        model = SE3Transformer(dim=8, depth=1, attend_self=True,
+                               num_neighbors=4, num_degrees=2,
+                               output_degrees=2, **kwargs)
+        _, feats, coors, mask = _data()
+        R = rot(0.2, 1.0, -0.4)
+        rot32 = lambda c: jnp.asarray(np.asarray(c, np.float64) @ R, F32)
+        out1 = model(feats, rot32(coors), mask, return_type=1)
+        out2 = np.asarray(model(feats, coors, mask, return_type=1),
+                          np.float64) @ R
+        assert np.abs(np.asarray(out1, np.float64) - out2).max() < 1e-4, kwargs
+
+
+def test_causal_no_future_information_flow():
+    """Perturbing a later node must not change earlier outputs."""
+    model = SE3Transformer(dim=8, depth=1, num_degrees=2, num_neighbors=6,
+                           causal=True, attend_self=True)
+    rng, feats, coors, mask = _data()
+    out1 = np.asarray(model(feats, coors, mask, return_type=0))
+
+    feats2 = np.asarray(feats).copy()
+    coors2 = np.asarray(coors).copy()
+    feats2[0, -1] += 10.0
+    coors2[0, -1] += 5.0
+    out2 = np.asarray(model(jnp.asarray(feats2), jnp.asarray(coors2), mask,
+                            return_type=0))
+    assert np.abs(out1[0, :8] - out2[0, :8]).max() < 1e-5
+    assert np.abs(out1[0, -1] - out2[0, -1]).max() > 1e-4
+
+
+def test_neighbor_mask_argument():
+    """Nodes excluded by neighbor_mask must not influence outputs."""
+    rng, feats, coors, mask = _data()
+    n = 16
+    model = SE3Transformer(dim=8, depth=1, num_degrees=2, num_neighbors=15,
+                           attend_self=True, seed=7)
+    nb_mask = np.ones((1, n, n), bool)
+    nb_mask[:, :, 8:] = False  # nobody may attend to nodes >= 8
+    nb_mask = jnp.asarray(nb_mask)
+
+    out1 = np.asarray(model(feats, coors, mask, neighbor_mask=nb_mask,
+                            return_type=0))
+    coors2 = np.asarray(coors).copy()
+    coors2[0, 12] += 3.0  # move an excluded node
+    out2 = np.asarray(model(feats, jnp.asarray(coors2), mask,
+                            neighbor_mask=nb_mask, return_type=0))
+    # excluded node's own row changes (its query sees others), but other
+    # rows must be unaffected
+    assert np.abs(out1[0, :8] - out2[0, :8]).max() < 1e-5
+
+
+def test_egnn_options():
+    model = SE3Transformer(dim=8, depth=2, num_degrees=2, num_neighbors=4,
+                           use_egnn=True, egnn_hidden_dim=16,
+                           egnn_weights_clamp_value=2.0,
+                           egnn_feedforward=True)
+    _, feats, coors, mask = _data()
+    out = model(feats, coors, mask, return_type=1)
+    assert out.shape == (1, 16, 8, 3)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_global_feats_dict_input():
+    model = SE3Transformer(dim=8, depth=1, num_degrees=2, num_neighbors=4,
+                           global_feats_dim=6)
+    rng, feats, coors, mask = _data()
+    gf = {'0': jnp.asarray(rng.normal(size=(1, 2, 6, 1)), F32)}
+    out = model(feats, coors, mask, return_type=0, global_feats=gf)
+    assert out.shape == (1, 16, 8)
+
+
+def test_output_degrees_one_forces_type0():
+    model = SE3Transformer(dim=8, depth=1, num_degrees=2, output_degrees=1,
+                           num_neighbors=4)
+    _, feats, coors, mask = _data()
+    out = model(feats, coors, mask)  # no return_type given
+    assert out.shape == (1, 16, 8)
+
+
+def test_shared_radial_hidden_equivariance():
+    model = SE3Transformer(dim=8, depth=1, attend_self=True,
+                           num_neighbors=4, num_degrees=2, output_degrees=2,
+                           shared_radial_hidden=True)
+    _, feats, coors, mask = _data()
+    R = rot(0.3, 1.0, -0.5)
+    rot32 = lambda c: jnp.asarray(np.asarray(c, np.float64) @ R, F32)
+    out1 = model(feats, rot32(coors), mask, return_type=1)
+    out2 = np.asarray(model(feats, coors, mask, return_type=1),
+                      np.float64) @ R
+    assert np.abs(np.asarray(out1, np.float64) - out2).max() < 1e-4

@@ -56,3 +56,11 @@ def test_shard_batch_placement():
     placed = shard_batch(batch, mesh)
     for v in placed.values():
         assert len(v.sharding.device_set) in (4, 8)
+
+
+def test_pod_mesh_cpu_fallback():
+    from se3_transformer_tpu.parallel import distributed
+    assert distributed.initialize() is False  # single host: no-op
+    mesh = distributed.pod_mesh(dp=2, sp=2, tp=2)
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ('dp', 'sp', 'tp')

@@ -56,18 +56,27 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _pick_blocks(E: int, IF: int, O: int, mid: int,
-                 vmem_budget: int = 10 * 2 ** 20):
-    """Choose (block_e, block_if) so W3 chunk + R chunk + V2 fit in VMEM."""
+                 vmem_budget: int = 10 * 2 ** 20,
+                 bwd: bool = False):
+    """Choose (block_e, block_if) so the kernel working set fits in VMEM.
+
+    The backward kernel's working set is roughly double the forward's
+    (extra dR chunk, g input block, and dW3/dV2/dH output blocks), so it
+    gets its own accounting."""
     block_if = min(IF, 128)
     while True:
-        # W3 chunk + double-buffered R + H + V2 + out (f32 accounting)
         for block_e in (256, 128, 64, 32, 16, 8):
             w3 = mid * block_if * O * 4
             r = block_e * block_if * O * 4
             v2 = block_e * 8 * block_if * 4
             out = block_e * 8 * O * 4
             h = block_e * mid * 4
-            if w3 + 2 * r + v2 + out + h <= vmem_budget:
+            total = w3 + 2 * r + v2 + out + h
+            if bwd:
+                # + dR chunk, g block, dW3 (w3-sized), dV2 (v2-sized),
+                # dH (h-sized) blocks
+                total += r + out + w3 + v2 + h
+            if total <= vmem_budget:
                 return block_e, block_if
         if block_if <= 8:
             return 8, block_if
@@ -124,3 +133,116 @@ def fused_pairwise_conv(h: jnp.ndarray, w3: jnp.ndarray, v2: jnp.ndarray,
 
 def pallas_available() -> bool:
     return jax.default_backend() == 'tpu'
+
+
+# --------------------------------------------------------------------- #
+# fused backward
+# --------------------------------------------------------------------- #
+# Cotangents of out[e,P,o] = sum_{if} V2[e,P,if] (H W3)[e,if,o]:
+#   dV2[e,P,if] = sum_o  g[e,P,o]  R[e,if,o]
+#   dR [e,if,o] = sum_P  V2[e,P,if] g[e,P,o]
+#   dH [e,m]    = sum_{if,o} dR[e,if,o] W3[m,if,o]     (shared matmul)
+#   dW3[m,if,o] = sum_e  H[e,m] dR[e,if,o]             (shared matmul)
+# R and dR exist only as VMEM chunks. Accumulations that would revisit
+# output blocks non-consecutively (dH over the outer if-axis) are written
+# as per-chunk partials and reduced outside; dW3 accumulates over the
+# minormost (e) axis, which is the legal consecutive-revisit pattern.
+
+
+def _bwd_kernel(h_ref, w3_ref, v2_ref, g_ref,
+                dv2_ref, dh_ref, dw3_ref):
+    e = pl.program_id(1)
+
+    # R chunk for dV2
+    r = jax.lax.dot_general(
+        h_ref[:], w3_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [E_b, IF_b, O]
+    g = g_ref[:]                                         # [E_b, P, O]
+    dv2_ref[0] = jax.lax.dot_general(
+        g, r, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(dv2_ref.dtype)
+
+    # dR chunk: per-edge [IF_b, P] @ [P, O]
+    dr = jax.lax.dot_general(
+        v2_ref[:], g, dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)              # [E_b, IF_b, O]
+
+    # dH partial for this if-chunk: [E_b, IF_b*O] @ [IF_b*O, mid]
+    dh_ref[0] = jax.lax.dot_general(
+        dr, w3_ref[:],
+        dimension_numbers=(((1, 2), (1, 2)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dh_ref.dtype)
+
+    # dW3 chunk accumulated over the inner e-axis (consecutive revisits)
+    upd = jax.lax.dot_general(
+        h_ref[:], dr, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [mid, IF_b, O]
+
+    @pl.when(e == 0)
+    def _():
+        dw3_ref[:] = upd.astype(dw3_ref.dtype)
+
+    @pl.when(e > 0)
+    def _():
+        dw3_ref[:] = dw3_ref[:] + upd.astype(dw3_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=('interpret',))
+def fused_pairwise_conv_bwd(h: jnp.ndarray, w3: jnp.ndarray,
+                            v2: jnp.ndarray, g: jnp.ndarray,
+                            interpret: bool = False):
+    """Backward of fused_pairwise_conv: returns (dh, dw3, dv2), all f32.
+
+    h [E, mid], w3 [mid, IF, O], v2 [E, P, IF], g [E, P, O].
+    """
+    E, mid = h.shape
+    _, IF, O = w3.shape
+    P = v2.shape[1]
+
+    block_e, block_if = _pick_blocks(E, IF, O, mid, bwd=True)
+    Ep = _round_up(E, block_e)
+    IFp = _round_up(IF, block_if)
+    if Ep != E:
+        h = jnp.pad(h, ((0, Ep - E), (0, 0)))
+        v2 = jnp.pad(v2, ((0, Ep - E), (0, 0), (0, 0)))
+        g = jnp.pad(g, ((0, Ep - E), (0, 0), (0, 0)))
+    if IFp != IF:
+        w3 = jnp.pad(w3, ((0, 0), (0, IFp - IF), (0, 0)))
+        v2 = jnp.pad(v2, ((0, 0), (0, 0), (0, IFp - IF)))
+
+    n_if = IFp // block_if
+    n_e = Ep // block_e
+
+    dv2, dh_partial, dw3 = pl.pallas_call(
+        _bwd_kernel,
+        grid=(n_if, n_e),
+        in_specs=[
+            pl.BlockSpec((block_e, mid), lambda f, e: (e, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((mid, block_if, O), lambda f, e: (0, f, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_e, P, block_if), lambda f, e: (e, 0, f),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_e, P, O), lambda f, e: (e, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_e, P, block_if),
+                         lambda f, e: (f, e, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_e, mid), lambda f, e: (f, e, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((mid, block_if, O), lambda f, e: (0, f, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_if, Ep, P, block_if), jnp.float32),
+            jax.ShapeDtypeStruct((n_if, Ep, mid), jnp.float32),
+            jax.ShapeDtypeStruct((mid, IFp, O), jnp.float32),
+        ],
+        interpret=interpret,
+    )(h, w3, v2, g)
+
+    # dv2 partial blocks [n_if, Ep, P, block_if] -> [Ep, P, IFp]
+    dv2 = dv2.transpose(1, 2, 0, 3).reshape(Ep, P, IFp)
+    dh = dh_partial.sum(axis=0)
+    return dh[:E], dw3[:, :IF], dv2[:E, :, :IF]

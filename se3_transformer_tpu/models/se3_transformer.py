@@ -380,7 +380,8 @@ class SE3TransformerModule(nn.Module):
                 edge_dim=conv_kwargs['edge_dim'],
                 hidden_dim=self.egnn_hidden_dim,
                 coor_weights_clamp_value=self.egnn_weights_clamp_value,
-                feedforward=self.egnn_feedforward, name='egnn_net')(
+                feedforward=self.egnn_feedforward,
+                reversible=self.reversible, name='egnn_net')(
                     x, edge_info, rel_dist, basis=basis,
                     global_feats=global_feats, pos_emb=pos_emb, mask=mask)
 

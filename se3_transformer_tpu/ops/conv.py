@@ -88,15 +88,13 @@ def _pc_fwd(h, w3b, v2, interpret=False):
 
 
 def _pc_bwd(interpret, res, g):
-    # backward via XLA einsums (materializes R for the backward only; a
-    # fused backward kernel is a later optimization)
+    # fused backward kernel: dR/R exist only as VMEM chunks (see
+    # kernels.pallas_pairwise.fused_pairwise_conv_bwd)
+    from ..kernels.pallas_pairwise import fused_pairwise_conv_bwd
     h, w3b, v2 = res
-    R = jnp.einsum('em,mko->eko', h, w3b)
-    dv2 = jnp.einsum('epo,eko->epk', g, R)
-    dR = jnp.einsum('epk,epo->eko', v2, g)
-    dh = jnp.einsum('eko,mko->em', dR, w3b)
-    dw3 = jnp.einsum('em,eko->mko', h, dR)
-    return dh, dw3, dv2
+    dh, dw3, dv2 = fused_pairwise_conv_bwd(h, w3b, v2, g,
+                                           interpret=interpret)
+    return (dh.astype(h.dtype), dw3.astype(w3b.dtype), dv2.astype(v2.dtype))
 
 
 _pairwise_contract_pallas.defvjp(_pc_fwd, _pc_bwd)

@@ -158,6 +158,9 @@ class EGnnNetwork(nn.Module):
     hidden_dim: int = 32
     coor_weights_clamp_value: Optional[float] = None
     feedforward: bool = False
+    # rematerialize each layer's activations (the EGNN analogue of the
+    # reference's reversible trunk memory class)
+    reversible: bool = False
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -181,13 +184,17 @@ class EGnnNetwork(nn.Module):
 
         edge_info = (neighbor_indices, neighbor_masks, edges)
 
+        egnn_cls, ff_cls = EGNN, FeedForwardBlockSE3
+        if self.reversible:
+            egnn_cls = nn.remat(EGNN)
+            ff_cls = nn.remat(FeedForwardBlockSE3)
+
         for i in range(self.depth):
-            features = EGNN(
+            features = egnn_cls(
                 self.fiber, hidden_dim=self.hidden_dim,
                 edge_dim=self.edge_dim,
                 coor_weights_clamp_value=self.coor_weights_clamp_value,
                 name=f'egnn{i}')(features, edge_info, rel_dist, mask=mask)
             if self.feedforward:
-                features = FeedForwardBlockSE3(self.fiber, name=f'ff{i}')(
-                    features)
+                features = ff_cls(self.fiber, name=f'ff{i}')(features)
         return features

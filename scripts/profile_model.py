@@ -1,0 +1,46 @@
+"""Capture a jax.profiler trace of a training step (xprof/perfetto).
+
+Usage: python scripts/profile_model.py [--out /tmp/se3_trace] [--cpu]
+The named_scope labels (neighbors/basis/conv_in/trunk/conv_out) make the
+trace segments directly attributable to model stages.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--out', default='/tmp/se3_trace')
+    ap.add_argument('--cpu', action='store_true')
+    ap.add_argument('--nodes', type=int, default=256)
+    ap.add_argument('--steps', type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update('jax_platforms', 'cpu')
+
+    import numpy as np
+
+    from se3_transformer_tpu.training import DenoiseConfig, DenoiseTrainer
+    from se3_transformer_tpu.utils.observability import profile_trace
+
+    cfg = DenoiseConfig(num_nodes=args.nodes, batch_size=1, num_degrees=2,
+                        max_sparse_neighbors=8)
+    trainer = DenoiseTrainer(cfg)
+    from se3_transformer_tpu.training.denoise import synthetic_protein_batch
+    batch = synthetic_protein_batch(cfg, np.random.RandomState(0))
+    trainer.train_step(batch)  # compile outside the trace
+
+    with profile_trace(args.out):
+        for _ in range(args.steps):
+            loss = trainer.train_step(batch)
+        jax.block_until_ready(loss)
+    print(f'trace written to {args.out} (open with xprof/tensorboard)')
+
+
+if __name__ == '__main__':
+    main()

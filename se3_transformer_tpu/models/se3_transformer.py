@@ -42,6 +42,7 @@ from ..ops.rotary import sinusoidal_embeddings
 from ..utils.helpers import (
     batched_index_select, cast_tuple, masked_mean, safe_cat,
 )
+from ..utils.observability import named_scope
 
 Features = Dict[str, jnp.ndarray]
 
@@ -263,10 +264,11 @@ class SE3TransformerModule(nn.Module):
         total_neighbors = int(min(neighbors + num_sparse, n - 1))
         assert total_neighbors > 0, 'must fetch at least 1 neighbor'
 
-        hood, nearest = select_neighbors(
-            rel_pos, indices, total_neighbors, valid_radius,
-            pair_mask=pair_mask, neighbor_mask=neighbor_mask,
-            sparse_mask=sparse_mask, causal=self.causal)
+        with named_scope('neighbors'):
+            hood, nearest = select_neighbors(
+                rel_pos, indices, total_neighbors, valid_radius,
+                pair_mask=pair_mask, neighbor_mask=neighbor_mask,
+                sparse_mask=sparse_mask, causal=self.causal)
 
         if edges is not None:
             edges = batched_index_select(edges, nearest, axis=2)
@@ -275,8 +277,9 @@ class SE3TransformerModule(nn.Module):
         pos_emb = self._rotary_embeddings(b, n, hood)
 
         # basis, in-trace (reference :1329)
-        basis = get_basis(hood.rel_pos, num_degrees - 1,
-                          differentiable=self.differentiable_coors)
+        with named_scope('basis'):
+            basis = get_basis(hood.rel_pos, num_degrees - 1,
+                              differentiable=self.differentiable_coors)
 
         edge_info = (hood.indices, hood.mask, edges)
         x = feats
@@ -289,8 +292,9 @@ class SE3TransformerModule(nn.Module):
             shared_radial_hidden=self.shared_radial_hidden)
 
         # project in + pre-convs (reference :1338-1344)
-        x = ConvSE3(fiber_in, fiber_hidden, name='conv_in', **conv_kwargs)(
-            x, edge_info, hood.rel_dist, basis)
+        with named_scope('conv_in'):
+            x = ConvSE3(fiber_in, fiber_hidden, name='conv_in',
+                        **conv_kwargs)(x, edge_info, hood.rel_dist, basis)
         for i in range(self.num_conv_layers):
             x = NormSE3(fiber_hidden, gated_scale=self.norm_gated_scale,
                         name=f'preconv_norm{i}')(x)
@@ -298,13 +302,16 @@ class SE3TransformerModule(nn.Module):
                         **conv_kwargs)(x, edge_info, hood.rel_dist, basis)
 
         # trunk (reference :1096-1109, :1348)
-        x = self._trunk(x, fiber_hidden, edge_info, hood.rel_dist, basis,
-                        global_feats, pos_emb, mask, conv_kwargs)
+        with named_scope('trunk'):
+            x = self._trunk(x, fiber_hidden, edge_info, hood.rel_dist,
+                            basis, global_feats, pos_emb, mask, conv_kwargs)
 
         # project out (reference :1352-1363)
         if fiber_out is not None:
-            x = ConvSE3(fiber_hidden, fiber_out, name='conv_out',
-                        **conv_kwargs)(x, edge_info, hood.rel_dist, basis)
+            with named_scope('conv_out'):
+                x = ConvSE3(fiber_hidden, fiber_out, name='conv_out',
+                            **conv_kwargs)(x, edge_info, hood.rel_dist,
+                                           basis)
 
         if (self.norm_out or self.reversible) and fiber_out is not None:
             x = NormSE3(fiber_out, gated_scale=self.norm_gated_scale,

@@ -45,6 +45,9 @@ class MetricLogger:
     def __init__(self, path: Optional[str] = None, mirror=print):
         self.path = path
         self.mirror = mirror
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
         self._fh = open(path, 'a') if path else None
         self._t0 = time.time()
 

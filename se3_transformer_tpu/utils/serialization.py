@@ -1,0 +1,47 @@
+"""Parameter save/load (msgpack via flax.serialization).
+
+Lightweight single-file params I/O for inference/export use-cases; the
+training checkpoint story (step-indexed, optimizer state, GC, resume) is
+training/checkpoint.py. The reference has neither (SURVEY.md §5).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+from flax import serialization
+
+
+def save_params(path: str, params: Any) -> str:
+    """Serialize a params pytree to `path` (atomic write)."""
+    data = serialization.to_bytes(jax.device_get(params))
+    tmp = path + '.tmp'
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(tmp, 'wb') as f:
+        f.write(data)
+    os.replace(tmp, path)
+    return path
+
+
+def load_params(path: str, like: Any) -> Any:
+    """Restore a params pytree saved with save_params; `like` supplies the
+    tree structure/shapes (e.g. a freshly initialized params tree).
+
+    Raises ValueError naming the first mismatching leaf when the file was
+    saved from a different architecture (flax's from_bytes restores by
+    structure and would otherwise hand back wrongly-shaped arrays that
+    fail much later inside apply)."""
+    with open(path, 'rb') as f:
+        restored = serialization.from_bytes(like, f.read())
+    ref_leaves, ref_tree = jax.tree_util.tree_flatten_with_path(like)
+    got_leaves = jax.tree_util.tree_leaves(restored)
+    for (keypath, ref), got in zip(ref_leaves, got_leaves):
+        ref_shape = getattr(ref, 'shape', None)
+        got_shape = getattr(got, 'shape', None)
+        if ref_shape != got_shape:
+            name = jax.tree_util.keystr(keypath)
+            raise ValueError(
+                f'checkpoint/architecture mismatch at {name}: '
+                f'file has {got_shape}, model expects {ref_shape}')
+    return restored

@@ -15,5 +15,8 @@ setup(
         'numpy',
         'scipy',
     ],
-    extras_require={'test': ['pytest']},
+    extras_require={
+        'test': ['pytest', 'orbax-checkpoint'],
+        'checkpoint': ['orbax-checkpoint'],
+    },
 )

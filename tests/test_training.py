@@ -70,3 +70,31 @@ def test_accumulating_step():
                                    jax.random.PRNGKey(0))
     assert np.isfinite(float(loss))
     assert float(params['w']) > 0  # moved toward y/x = 2
+
+
+def test_params_serialization_roundtrip(tmp_path):
+    import os
+    from se3_transformer_tpu.utils.serialization import load_params, save_params
+    cfg = DenoiseConfig(num_nodes=12, batch_size=1, num_degrees=2,
+                        max_sparse_neighbors=4)
+    trainer = DenoiseTrainer(cfg)
+    trainer.init()
+    path = os.path.join(tmp_path, 'params.msgpack')
+    save_params(path, trainer.params)
+    restored = load_params(path, trainer.params)
+    for a, b in zip(jax.tree_util.tree_leaves(trainer.params),
+                    jax.tree_util.tree_leaves(restored)):
+        assert np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_metric_logger(tmp_path):
+    import json, os
+    from se3_transformer_tpu.utils.observability import MetricLogger
+    path = os.path.join(tmp_path, 'metrics.jsonl')
+    logger = MetricLogger(path, mirror=None)
+    logger.log(1, loss=0.5, grad_norm=jnp.asarray(2.0))
+    logger.log(2, loss=0.25)
+    logger.close()
+    recs = [json.loads(l) for l in open(path)]
+    assert recs[0]['step'] == 1 and abs(recs[0]['grad_norm'] - 2.0) < 1e-9
+    assert recs[1]['loss'] == 0.25

@@ -18,6 +18,8 @@ def main():
     ap.add_argument('--nodes', type=int, default=256)
     ap.add_argument('--steps', type=int, default=3)
     args = ap.parse_args()
+    if args.steps < 1:
+        ap.error('--steps must be >= 1')
 
     import jax
     if args.cpu:

@@ -116,6 +116,9 @@ class SE3TransformerModule(nn.Module):
     matmul_precision: Optional[str] = None
     # share one radial hidden trunk across degree pairs (perf option)
     shared_radial_hidden: bool = False
+    # stream the node axis through the pairwise contraction in N chunks
+    # (XLA path; memory ceiling for huge channel counts)
+    edge_chunks: Optional[int] = None
 
     # ------------------------------------------------------------------ #
     # static configuration helpers (resolved at trace time)
@@ -289,7 +292,8 @@ class SE3TransformerModule(nn.Module):
             fourier_encode_dist=self.fourier_encode_dist,
             num_fourier_features=self.rel_dist_num_fourier_features,
             pallas=self.pallas,
-            shared_radial_hidden=self.shared_radial_hidden)
+            shared_radial_hidden=self.shared_radial_hidden,
+            edge_chunks=self.edge_chunks)
 
         # project in + pre-convs (reference :1338-1344)
         with named_scope('conv_in'):
@@ -408,7 +412,8 @@ class SE3TransformerModule(nn.Module):
             one_headed_key_values=self.one_headed_key_values,
             norm_gated_scale=self.norm_gated_scale,
             reversible=self.reversible, pallas=self.pallas,
-            shared_radial_hidden=self.shared_radial_hidden, name='trunk')(
+            shared_radial_hidden=self.shared_radial_hidden,
+            edge_chunks=self.edge_chunks, name='trunk')(
                 x, edge_info, rel_dist, basis, global_feats, pos_emb, mask)
 
 

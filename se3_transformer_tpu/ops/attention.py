@@ -42,6 +42,7 @@ class AttentionSE3(nn.Module):
     tie_key_values: bool = False
     pallas: Optional[bool] = None
     shared_radial_hidden: bool = False
+    edge_chunks: Optional[int] = None
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -68,7 +69,8 @@ class AttentionSE3(nn.Module):
             fourier_encode_dist=self.fourier_encode_dist,
             num_fourier_features=self.rel_dist_num_fourier_features,
             pallas=self.pallas,
-            shared_radial_hidden=self.shared_radial_hidden)
+            shared_radial_hidden=self.shared_radial_hidden,
+            edge_chunks=self.edge_chunks)
 
         queries = LinearSE3(self.fiber, hidden_fiber, name='to_q')(features)
         values = ConvSE3(self.fiber, kv_fiber, name='to_v', **conv_kwargs)(
@@ -196,6 +198,7 @@ class AttentionBlockSE3(nn.Module):
     norm_gated_scale: bool = False
     pallas: Optional[bool] = None
     shared_radial_hidden: bool = False
+    edge_chunks: Optional[int] = None
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -217,6 +220,7 @@ class AttentionBlockSE3(nn.Module):
             tie_key_values=self.tie_key_values,
             pallas=self.pallas,
             shared_radial_hidden=self.shared_radial_hidden,
+            edge_chunks=self.edge_chunks,
             name='attn')(out, edge_info, rel_dist, basis, global_feats,
                          pos_emb, mask)
         return residual_se3(out, res)

@@ -115,6 +115,10 @@ class PairwiseConvSE3(nn.Module):
     mid_dim: int = DEFAULT_MID_DIM
     pallas: Optional[bool] = None
     pallas_interpret: bool = False
+    # stream the node axis in this many chunks through the contraction
+    # (lax.map + remat): bounds peak memory to O(E/edge_chunks * c_in *
+    # c_out * F) for huge configs (e.g. dim-512 flagship). None = off.
+    edge_chunks: Optional[int] = None
 
     @nn.compact
     def __call__(self, edge_feats: jnp.ndarray, basis_slice: jnp.ndarray,
@@ -147,7 +151,27 @@ class PairwiseConvSE3(nn.Module):
             use_pallas = jax.default_backend() == 'tpu'
 
         lead = h.shape[:-1]
-        if use_pallas or self.pallas_interpret:
+        if self.edge_chunks is not None:
+            # explicit edge_chunks takes precedence over the Pallas kernel
+            # (the kernel bounds VMEM, but at huge channel counts the HBM
+            # h/v2/out tensors themselves need node-axis streaming): the
+            # per-chunk R tensor is rematerialized in the backward, so peak
+            # memory is bounded by the chunk size in both passes
+            n = h.shape[1]
+            c = self.edge_chunks
+            assert n % c == 0, f'nodes {n} must divide into {c} edge_chunks'
+
+            def chunk_fn(args):
+                h_c, v2_c = args
+                R = jnp.einsum('...m,mko->...ko', h_c, w3) + b3
+                return jnp.einsum('...pk,...ko->...po', v2_c, R)
+
+            h_s = h.reshape(h.shape[0], c, n // c, *h.shape[2:])
+            v2_s = v2.reshape(v2.shape[0], c, n // c, *v2.shape[2:])
+            h_s, v2_s = jnp.swapaxes(h_s, 0, 1), jnp.swapaxes(v2_s, 0, 1)
+            out = jax.lax.map(jax.checkpoint(chunk_fn), (h_s, v2_s))
+            out = jnp.swapaxes(out, 0, 1).reshape(*lead, P, self.nc_out)
+        elif use_pallas or self.pallas_interpret:
             E = 1
             for s in lead:
                 E *= s
@@ -188,6 +212,7 @@ class ConvSE3(nn.Module):
     num_fourier_features: int = 4
     pallas: Optional[bool] = None
     pallas_interpret: bool = False
+    edge_chunks: Optional[int] = None
     # share one radial hidden trunk across all degree pairs (perf option;
     # the reference uses an independent MLP per pair, which dominates FLOPs
     # at small channel counts — parameterization differs when enabled)
@@ -226,6 +251,7 @@ class ConvSE3(nn.Module):
                     degree_in, m_in, degree_out, m_out,
                     pallas=self.pallas,
                     pallas_interpret=self.pallas_interpret,
+                    edge_chunks=self.edge_chunks,
                     name=f'pair_{degree_in}_{degree_out}')(
                         edge_features,
                         basis[f'{degree_in},{degree_out}'],

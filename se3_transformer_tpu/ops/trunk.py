@@ -49,6 +49,7 @@ class SequentialTrunk(nn.Module):
     reversible: bool = False
     pallas: Optional[bool] = None
     shared_radial_hidden: bool = False
+    edge_chunks: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: Features, edge_info, rel_dist, basis,
@@ -72,6 +73,7 @@ class SequentialTrunk(nn.Module):
                 norm_gated_scale=self.norm_gated_scale,
                 pallas=self.pallas,
                 shared_radial_hidden=self.shared_radial_hidden,
+                edge_chunks=self.edge_chunks,
                 name=f'attn_block{i}')(
                     x, edge_info, rel_dist, basis, global_feats, pos_emb,
                     mask)

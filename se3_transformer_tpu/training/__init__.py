@@ -3,3 +3,5 @@ from .denoise import (
     chain_adjacency,
 )
 from .checkpoint import CheckpointManager
+from .data import BackgroundBatcher, prefetch_to_device
+from .recipes import RECIPES

@@ -1,0 +1,99 @@
+"""Input pipeline: background batch preparation + device prefetch.
+
+The TPU must never wait on the host (DESIGN.md §6). This module provides
+the Python-side pump around the native C++ batch builders: a background
+thread prepares batches (tokenize/pad/adjacency via native.loader) while
+the device computes, and `prefetch_to_device` keeps `size` batches
+in-flight so step N+1's H2D copy overlaps step N's compute.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+import jax
+
+
+def prefetch_to_device(iterator: Iterable, size: int = 2,
+                       sharding=None) -> Iterator:
+    """Wrap a host batch iterator so device transfer happens `size` steps
+    ahead. With `sharding` (e.g. NamedSharding from parallel.mesh), batches
+    are placed directly into their SPMD layout."""
+
+    def place(batch):
+        if sharding is None:
+            return jax.tree_util.tree_map(jax.device_put, batch)
+        from ..parallel.mesh import shard_batch
+        if isinstance(batch, dict):
+            return shard_batch(batch, sharding) \
+                if hasattr(sharding, 'devices') else jax.device_put(
+                    batch, sharding)
+        return jax.device_put(batch, sharding)
+
+    buf = []
+    it = iter(iterator)
+    try:
+        for _ in range(size):
+            buf.append(place(next(it)))
+    except StopIteration:
+        pass
+    for batch in it:
+        nxt = place(batch)
+        out, buf = buf[0], buf[1:] + [nxt]
+        yield out
+    yield from buf
+
+
+class BackgroundBatcher:
+    """Run a batch-building callable on a background thread (the host-side
+    C++ builders release the GIL inside ctypes calls, so preparation
+    genuinely overlaps device compute)."""
+
+    def __init__(self, build_fn: Callable[[int], dict], capacity: int = 4):
+        self.build_fn = build_fn
+        self._q: queue.Queue = queue.Queue(maxsize=capacity)
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._idx = 0
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        try:
+            while not self._stop.is_set():
+                batch = self.build_fn(self._idx)
+                self._idx += 1
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(batch, timeout=0.25)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # propagate into the consumer
+            self._error = e
+            self._stop.set()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        while True:
+            try:
+                return self._q.get(timeout=1.0)
+            except queue.Empty:
+                if self._error is not None:
+                    raise RuntimeError(
+                        'BackgroundBatcher build_fn failed') from self._error
+                if self._stop.is_set() or not self._thread.is_alive():
+                    raise StopIteration
+                continue
+
+    def close(self):
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)

@@ -109,5 +109,6 @@ def test_faster_than_scipy_oracle():
     t_scipy, ref = best_of(lambda: _scipy_real_sh(l, theta, phi))
 
     assert np.abs(np.asarray(ours) - ref).max() < 1e-4
-    # best-of-3 with 2x headroom so CI scheduling noise can't flake this
-    assert t_ours < 2 * t_scipy, (t_ours, t_scipy)
+    # best-of-3 timing absorbs scheduler noise; ours is normally >10x
+    # faster, so a strict bound is still flake-safe
+    assert t_ours < t_scipy, (t_ours, t_scipy)

@@ -1,0 +1,61 @@
+"""Symmetry properties beyond the reference's rotation tests: translation
+invariance (the SE(3) 'T') and node-permutation equivariance."""
+import jax.numpy as jnp
+import numpy as np
+
+from se3_transformer_tpu import SE3Transformer
+
+F32 = jnp.float32
+
+
+def _data(b=1, n=16, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    feats = jnp.asarray(rng.normal(size=(b, n, d)), F32)
+    coors = jnp.asarray(rng.normal(size=(b, n, 3)), F32)
+    mask = jnp.ones((b, n), bool)
+    return rng, feats, coors, mask
+
+
+def test_translation_invariance():
+    """Outputs depend only on relative geometry: shifting every coordinate
+    by the same vector must not change any output type."""
+    model = SE3Transformer(dim=8, depth=1, attend_self=True,
+                           num_neighbors=4, num_degrees=2, output_degrees=2,
+                           seed=3)
+    _, feats, coors, mask = _data()
+    t = jnp.asarray([1.5, -2.0, 0.75], F32)
+    out1 = model(feats, coors, mask)
+    out2 = model(feats, coors + t, mask)
+    for d in out1:
+        assert np.abs(np.asarray(out1[d]) - np.asarray(out2[d])).max() < 2e-5
+
+
+def test_permutation_equivariance():
+    """Permuting the nodes permutes the outputs identically."""
+    model = SE3Transformer(dim=8, depth=1, attend_self=True,
+                           num_neighbors=4, num_degrees=2, output_degrees=2,
+                           seed=4)
+    rng, feats, coors, mask = _data()
+    perm = rng.permutation(16)
+    out1 = model(feats, coors, mask, return_type=1)
+    out2 = model(feats[:, perm], coors[:, perm], mask, return_type=1)
+    assert np.abs(np.asarray(out1)[:, perm] - np.asarray(out2)).max() < 2e-5
+
+
+def test_masked_node_features_do_not_affect_valid_outputs():
+    """Masked nodes may still OCCUPY kNN slots (the reference ranks
+    unmasked distances too, se3_transformer_pytorch.py:1283, masking after
+    the gather), but their FEATURES must never contribute to valid nodes'
+    outputs."""
+    model = SE3Transformer(dim=8, depth=1, attend_self=True,
+                           num_neighbors=4, num_degrees=2, output_degrees=2,
+                           seed=5)
+    rng, feats, coors, _ = _data()
+    mask = jnp.asarray(np.arange(16) < 12)[None]
+    out1 = np.asarray(model(feats, coors, mask, return_type=0))
+
+    feats2 = np.asarray(feats).copy()
+    feats2[0, 12:] = 99.0  # poison masked nodes' features, coords unchanged
+    out2 = np.asarray(model(jnp.asarray(feats2), coors, mask,
+                            return_type=0))
+    assert np.abs(out1[0, :12] - out2[0, :12]).max() < 2e-5

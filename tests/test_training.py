@@ -98,3 +98,19 @@ def test_metric_logger(tmp_path):
     recs = [json.loads(l) for l in open(path)]
     assert recs[0]['step'] == 1 and abs(recs[0]['grad_norm'] - 2.0) < 1e-9
     assert recs[1]['loss'] == 0.25
+
+
+def test_background_batcher_and_prefetch():
+    from se3_transformer_tpu.training.data import (
+        BackgroundBatcher, prefetch_to_device,
+    )
+    batcher = BackgroundBatcher(
+        lambda i: {'x': np.full((2, 3), i, np.float32)}, capacity=2)
+    seen = []
+    it = prefetch_to_device(batcher, size=2)
+    for _ in range(5):
+        b = next(it)
+        seen.append(float(np.asarray(b['x'])[0, 0]))
+    batcher.close()
+    assert seen == sorted(seen)  # in order
+    assert len(set(seen)) == 5   # distinct batches

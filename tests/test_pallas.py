@@ -127,3 +127,39 @@ def test_fused_kernels_multichunk_if_axis():
     assert jnp.abs(dv2 - dv2_ref).max() / scale(dv2_ref) < 1e-5
     assert jnp.abs(dh - dh_ref).max() / scale(dh_ref) < 1e-5
     assert jnp.abs(dw3 - dw3_ref).max() / scale(dw3_ref) < 1e-5
+
+
+@pytest.mark.parametrize('shape', [
+    # (E, mid, IF, O, P) — edge cases: singleton axes, non-multiples,
+    # IF > 128 (multi-chunk), E smaller than any block size
+    (1, 8, 1, 1, 1),
+    (3, 16, 2, 5, 3),
+    (130, 16, 7, 9, 7),
+    (8, 8, 200, 16, 5),
+    (257, 24, 130, 3, 1),
+])
+def test_fused_kernels_shape_fuzz(shape):
+    from se3_transformer_tpu.kernels.pallas_pairwise import (
+        fused_pairwise_conv, fused_pairwise_conv_bwd,
+    )
+    E, mid, IF, O, P = shape
+    rng = np.random.RandomState(sum(shape))
+    h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
+    w3 = jnp.asarray(rng.normal(size=(mid, IF, O)), jnp.float32)
+    v2 = jnp.asarray(rng.normal(size=(E, P, IF)), jnp.float32)
+    g = jnp.asarray(rng.normal(size=(E, P, O)), jnp.float32)
+
+    R = jnp.einsum('em,mko->eko', h, w3)
+    ref = jnp.einsum('epk,eko->epo', v2, R)
+    out = fused_pairwise_conv(h, w3, v2, interpret=True)
+    scale = float(jnp.abs(ref).max()) + 1e-9
+    assert jnp.abs(out - ref).max() / scale < 1e-5
+
+    dh, dw3, dv2 = fused_pairwise_conv_bwd(h, w3, v2, g, interpret=True)
+    dv2_ref = jnp.einsum('epo,eko->epk', g, R)
+    dR = jnp.einsum('epk,epo->eko', v2, g)
+    dh_ref = jnp.einsum('eko,mko->em', dR, w3)
+    dw3_ref = jnp.einsum('em,eko->mko', h, dR)
+    for a, b in ((dh, dh_ref), (dw3, dw3_ref), (dv2, dv2_ref)):
+        s = float(jnp.abs(b).max()) + 1e-9
+        assert jnp.abs(a - b).max() / s < 1e-5, shape

@@ -35,12 +35,12 @@ from ..ops.core import LinearSE3, NormSE3
 from ..ops.egnn import EGnnNetwork
 from ..ops.fiber import Fiber
 from ..ops.neighbors import (
-    exclude_self_indices, expand_adjacency, remove_self, select_neighbors,
-    sparse_neighbor_mask,
+    Neighborhood, exclude_self_indices, expand_adjacency, remove_self,
+    select_neighbors, sparse_neighbor_mask,
 )
 from ..ops.rotary import sinusoidal_embeddings
 from ..utils.helpers import (
-    batched_index_select, cast_tuple, masked_mean, safe_cat,
+    batched_index_select, cast_tuple, masked_mean, safe_cat, safe_norm,
 )
 from ..utils.observability import named_scope
 
@@ -152,17 +152,20 @@ class SE3TransformerModule(nn.Module):
     @nn.compact
     def __call__(self, feats, coors, mask=None, adj_mat=None, edges=None,
                  return_type=None, return_pooled=False, neighbor_mask=None,
-                 global_feats=None):
+                 global_feats=None, neighbors=None):
         if self.matmul_precision is not None:
             with jax.default_matmul_precision(self.matmul_precision):
                 return self._forward(
                     feats, coors, mask, adj_mat, edges, return_type,
-                    return_pooled, neighbor_mask, global_feats)
+                    return_pooled, neighbor_mask, global_feats, neighbors)
         return self._forward(feats, coors, mask, adj_mat, edges, return_type,
-                             return_pooled, neighbor_mask, global_feats)
+                             return_pooled, neighbor_mask, global_feats,
+                             neighbors)
 
     def _forward(self, feats, coors, mask, adj_mat, edges, return_type,
-                 return_pooled, neighbor_mask, global_feats):
+                 return_pooled, neighbor_mask, global_feats, neighbors=None):
+        precomputed_neighbors = neighbors
+        del neighbors
         num_degrees, fiber_in, fiber_hidden, fiber_out, output_degrees = \
             self._resolved()
 
@@ -205,10 +208,43 @@ class SE3TransformerModule(nn.Module):
             f'input must have degrees 0..{self.input_degrees - 1}'
 
         # static neighbor budget (reference :1277-1281, made static)
-        neighbors = self.num_neighbors
-        assert self.attend_sparse_neighbors or neighbors > 0, \
+        num_neighbors = self.num_neighbors
+        assert self.attend_sparse_neighbors or num_neighbors > 0 \
+            or precomputed_neighbors is not None, \
             'either attend to sparse neighbors or use num_neighbors > 0'
-        neighbors = int(min(neighbors, n - 1))
+        num_neighbors = int(min(num_neighbors, n - 1))
+
+        # precomputed neighborhoods (host C++ kNN via native.knn_graph, or
+        # ring kNN via parallel.ring) replace the O(n^2) on-device
+        # selection entirely — handled before any O(n^2) index tensors are
+        # even constructed
+        if precomputed_neighbors is not None:
+            assert not (self.attend_sparse_neighbors or self.causal
+                        or neighbor_mask is not None
+                        or self.num_adj_degrees is not None
+                        or edges is not None), \
+                'precomputed neighbors support plain kNN semantics only'
+            nbr_idx, nbr_mask = precomputed_neighbors
+            # clamp external indices: jnp gathers fill out-of-bounds with
+            # NaN, which would silently poison outputs
+            nbr_idx = jnp.clip(jnp.asarray(nbr_idx), 0, n - 1)
+            coors_j = batched_index_select(coors, nbr_idx, axis=1)
+            nbr_rel_pos = coors[:, :, None, :] - coors_j
+            nbr_rel_dist = safe_norm(nbr_rel_pos, axis=-1)
+            valid = nbr_rel_dist <= self.valid_radius
+            # guard against self-inclusive conventions (e.g. sklearn
+            # kneighbors returns the query itself as neighbor 0) and
+            # sentinel-padded indices that clamping mapped onto real nodes
+            valid = valid & (nbr_idx != jnp.arange(n)[None, :, None])
+            if nbr_mask is not None:
+                valid = valid & jnp.asarray(nbr_mask)
+            if mask is not None:
+                valid = valid & batched_index_select(mask, nbr_idx, axis=1)
+                valid = valid & mask[:, :, None]
+            hood = Neighborhood(nbr_idx, valid, nbr_rel_pos, nbr_rel_dist)
+            return self._body(feats, hood, edges, mask, global_feats,
+                              return_type, return_pooled, num_degrees,
+                              fiber_in, fiber_hidden, fiber_out, b, n)
 
         num_sparse = 0
         sparse_mask = None
@@ -263,8 +299,8 @@ class SE3TransformerModule(nn.Module):
             neighbor_mask = remove_self(neighbor_mask, self_excl)
 
         # fixed-K neighbor selection (reference :1241-1294)
-        valid_radius = self.valid_radius if neighbors > 0 else 0.
-        total_neighbors = int(min(neighbors + num_sparse, n - 1))
+        valid_radius = self.valid_radius if num_neighbors > 0 else 0.
+        total_neighbors = int(min(num_neighbors + num_sparse, n - 1))
         assert total_neighbors > 0, 'must fetch at least 1 neighbor'
 
         with named_scope('neighbors'):
@@ -276,6 +312,13 @@ class SE3TransformerModule(nn.Module):
         if edges is not None:
             edges = batched_index_select(edges, nearest, axis=2)
 
+        return self._body(feats, hood, edges, mask, global_feats,
+                          return_type, return_pooled, num_degrees,
+                          fiber_in, fiber_hidden, fiber_out, b, n)
+
+    def _body(self, feats, hood, edges, mask, global_feats, return_type,
+              return_pooled, num_degrees, fiber_in, fiber_hidden, fiber_out,
+              b, n):
         # rotary embeddings (reference :1298-1325)
         pos_emb = self._rotary_embeddings(b, n, hood)
 
@@ -443,10 +486,11 @@ class SE3Transformer:
 
     def __call__(self, feats, coors, mask=None, adj_mat=None, edges=None,
                  return_type=None, return_pooled=False, neighbor_mask=None,
-                 global_feats=None):
+                 global_feats=None, neighbors=None):
         kwargs = dict(mask=mask, adj_mat=adj_mat, edges=edges,
                       return_type=return_type, return_pooled=return_pooled,
-                      neighbor_mask=neighbor_mask, global_feats=global_feats)
+                      neighbor_mask=neighbor_mask, global_feats=global_feats,
+                      neighbors=neighbors)
         if self.params is None:
             init_fn = jax.jit(
                 self.module.init,

@@ -168,3 +168,30 @@ def test_edge_chunks_matches_default():
         {'params': m2.params}, feats, c, mask=mask, return_type=1) ** 2
     ).sum())(coors)
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_precomputed_neighbors_matches_internal_selection():
+    """Feeding the native C++ kNN's neighborhood must reproduce the
+    model's own on-device selection (same K, plain kNN semantics)."""
+    from se3_transformer_tpu.native import knn_graph
+
+    model = SE3Transformer(dim=8, depth=1, attend_self=True,
+                           num_neighbors=4, num_degrees=2, output_degrees=2,
+                           seed=21)
+    rng, feats, coors, mask = _data()
+    out_internal = model(feats, coors, mask, return_type=1)
+
+    idx, dist, nmask = knn_graph(np.asarray(coors), 4, radius=1e5)
+    out_pre = model(feats, coors, mask, return_type=1,
+                    neighbors=(jnp.asarray(idx), jnp.asarray(nmask)))
+    assert np.abs(np.asarray(out_internal) - np.asarray(out_pre)).max() < 2e-5
+
+
+def test_precomputed_neighbors_rejects_incompatible_config():
+    import pytest
+    model = SE3Transformer(dim=8, depth=1, attend_self=True, causal=True,
+                           num_neighbors=4, num_degrees=2, seed=22)
+    _, feats, coors, mask = _data()
+    nbr = (jnp.zeros((1, 16, 4), jnp.int32), jnp.ones((1, 16, 4), bool))
+    with pytest.raises(AssertionError, match='plain kNN'):
+        model(feats, coors, mask, return_type=0, neighbors=nbr)

@@ -35,3 +35,27 @@ def test_ring_knn_radius_semantics():
     # neighbor distances are ascending
     dd = np.asarray(d)
     assert (np.diff(dd, axis=-1) >= -1e-6).all()
+
+
+def test_ring_knn_feeds_model():
+    """Long-context workflow: ring kNN (sequence-parallel, exact) selects
+    neighbors; the model consumes them via the neighbors= kwarg and matches
+    its own internal dense selection."""
+    from se3_transformer_tpu import SE3Transformer
+
+    rng = np.random.RandomState(2)
+    n, k = 32, 4
+    coors = jnp.asarray(rng.normal(size=(1, n, 3)), jnp.float32)
+    feats = jnp.asarray(rng.normal(size=(1, n, 8)), jnp.float32)
+    mask = jnp.ones((1, n), bool)
+
+    mesh = make_mesh(dp=1, sp=8, tp=1)
+    dist, idx = ring_knn(coors, k, mesh)
+
+    model = SE3Transformer(dim=8, depth=1, attend_self=True,
+                           num_neighbors=k, num_degrees=2, output_degrees=2,
+                           seed=31)
+    out_internal = model(feats, coors, mask, return_type=1)
+    out_ring = model(feats, coors, mask, return_type=1,
+                     neighbors=(idx, dist <= 1e5))
+    assert np.abs(np.asarray(out_internal) - np.asarray(out_ring)).max() < 2e-5

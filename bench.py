@@ -126,6 +126,12 @@ def main(backend: str):
     dt = time.time() - t0
 
     nodes_steps_per_sec = batch * num_nodes * steps / dt
+
+    # equivariance L2 error of the trained model (the BASELINE metric's
+    # second component)
+    from se3_transformer_tpu.utils.validation import equivariance_l2
+    eq_err = equivariance_l2(module, params, seqs, coords, masks)
+
     actual = jax.default_backend()
     # RECORD is a TPU flagship-config number; a CPU fallback run measures a
     # different workload, so comparing would fabricate a regression
@@ -137,6 +143,7 @@ def main(backend: str):
         'value': round(nodes_steps_per_sec, 2),
         'unit': f'nodes*steps/sec/{"chip" if actual == "tpu" else "cpu-host"}',
         'vs_baseline': round(vs, 3),
+        'equivariance_l2': eq_err,
     }))
 
 

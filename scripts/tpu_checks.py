@@ -17,31 +17,26 @@ import jax.numpy as jnp
 import numpy as np
 
 from se3_transformer_tpu.models.se3_transformer import SE3TransformerModule
-from se3_transformer_tpu.so3 import rot
 
 
 def check_equivariance(precision: str):
+    from se3_transformer_tpu.utils.validation import equivariance_l2
+
     module = SE3TransformerModule(
         dim=16, depth=1, attend_self=True, num_neighbors=8, num_degrees=3,
         output_degrees=2, fourier_encode_dist=True)
     rng = np.random.RandomState(0)
     feats = jnp.asarray(rng.normal(size=(1, 32, 16)), jnp.float32)
-    coors64 = rng.normal(size=(1, 32, 3))
+    coors = jnp.asarray(rng.normal(size=(1, 32, 3)), jnp.float32)
     mask = jnp.ones((1, 32), bool)
-    R = rot(15, 0, 45)
-
     with jax.default_matmul_precision(precision):
-        params = module.init(jax.random.PRNGKey(0), feats,
-                             jnp.asarray(coors64, jnp.float32), mask=mask,
+        params = module.init(jax.random.PRNGKey(0), feats, coors, mask=mask,
                              return_type=1)['params']
-        fwd = jax.jit(lambda c: module.apply(
-            {'params': params}, feats, c, mask=mask, return_type=1))
-        out1 = fwd(jnp.asarray(coors64 @ R, jnp.float32))
-        out2 = np.asarray(fwd(jnp.asarray(coors64, jnp.float32)),
-                          np.float64) @ R
-    err = float(jnp.abs(out1 - jnp.asarray(out2, jnp.float32)).max())
-    scale = float(np.abs(out2).max())
-    return err, err / scale
+    err = equivariance_l2(module, params, feats, coors, mask,
+                          precision=precision)
+    scale = float(np.abs(np.asarray(module.apply(
+        {'params': params}, feats, coors, mask=mask, return_type=1))).max())
+    return err, err / max(scale, 1e-12)
 
 
 def bench_conv(pallas: bool, n=512, k=24, dim=32, degrees=3, iters=10):

@@ -115,7 +115,7 @@ def _load_cached_qj(J, d_in, d_out):
             key = f'{J}_{d_in}_{d_out}'
             if key in data:
                 return data[key]
-    except (OSError, ValueError):
+    except Exception:  # corrupted/truncated cache: treat as a miss
         return None
     return None
 
@@ -126,14 +126,46 @@ def _store_cached_qj(J, d_in, d_out, Q):
     try:
         os.makedirs(CACHE_PATH, exist_ok=True)
         path = _qj_cache_file()
-        existing = {}
-        if os.path.exists(path):
-            with np.load(path) as data:
-                existing = {k: data[k] for k in data.files}
-        existing[f'{J}_{d_in}_{d_out}'] = Q
-        tmp = path + f'.tmp{os.getpid()}'
-        np.savez(tmp, **existing)
-        os.replace(tmp, path)
+        # inter-process mutex around the read-modify-write (the role of the
+        # reference's FileLock, utils.py:169): concurrent writers would
+        # otherwise drop each other's entries. Locking failures degrade to
+        # best-effort (worst case: a recomputable cache miss).
+        lock_path = os.path.join(CACHE_PATH, 'qj.lock')
+        with open(lock_path, 'w') as lock_fh:
+            try:
+                import fcntl
+                fcntl.flock(lock_fh, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass
+            existing = {}
+            if os.path.exists(path):
+                try:
+                    with np.load(path) as data:
+                        existing = {k: data[k] for k in data.files}
+                except Exception:
+                    # corrupted cache: rebuild from scratch
+                    existing = {}
+            existing[f'{J}_{d_in}_{d_out}'] = Q
+            # NOTE: np.savez appends '.npz' when the name lacks it — the
+            # tmp name must already end in .npz or os.replace misses
+            tmp = path + f'.{os.getpid()}.tmp.npz'
+            np.savez(tmp, **existing)
+            os.replace(tmp, path)
+            # housekeeping: drop tmp files orphaned by crashed writers.
+            # Age-gated so an in-flight write from a writer running without
+            # the flock (no fcntl / flock failure) is never reaped.
+            import time as _time
+            base = os.path.basename(path)
+            cutoff = _time.time() - 300
+            for name in os.listdir(CACHE_PATH):
+                full = os.path.join(CACHE_PATH, name)
+                if (name.startswith(base + '.') and name.endswith('.tmp.npz')
+                        and name != os.path.basename(tmp)):
+                    try:
+                        if os.path.getmtime(full) < cutoff:
+                            os.remove(full)
+                    except OSError:
+                        pass
     except OSError:
         pass
 

@@ -86,3 +86,39 @@ def test_basis_jits():
     ref = get_basis(rel_pos, 2)
     for k in ref:
         assert jnp.allclose(out[k], ref[k], atol=1e-12)
+
+
+def _qj_cache_worker(cache_dir, pairs):
+    """Module-level so multiprocessing 'spawn' can pickle it."""
+    import importlib
+    import os
+    os.environ['SE3_TPU_CACHE_PATH'] = cache_dir
+    import se3_transformer_tpu.basis as basis_mod
+    importlib.reload(basis_mod)
+    for J, di, do in pairs:
+        basis_mod.basis_transformation_Q_J(J, di, do)
+
+
+def test_qj_cache_concurrent_writers(tmp_path, monkeypatch):
+    """Concurrent Q_J writers must not drop each other's entries (the
+    reference guarded its disk cache with FileLock; we use flock)."""
+    import multiprocessing as mp
+    import os
+    import sys
+
+    cache_dir = str(tmp_path / 'qjcache')
+    jobs = [[(0, 0, 0), (1, 0, 1), (1, 1, 0)],
+            [(1, 1, 1), (2, 1, 1), (0, 1, 1)]]
+    ctx = mp.get_context('spawn' if sys.platform != 'linux' else 'fork')
+    procs = [ctx.Process(target=_qj_cache_worker, args=(cache_dir, j))
+             for j in jobs]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(120)
+        assert p.exitcode == 0
+
+    data = np.load(os.path.join(cache_dir, 'qj_v1.npz'))
+    keys = set(data.files)
+    expected = {'0_0_0', '1_0_1', '1_1_0', '1_1_1', '2_1_1', '0_1_1'}
+    assert expected <= keys, keys

@@ -195,3 +195,35 @@ def test_precomputed_neighbors_rejects_incompatible_config():
     nbr = (jnp.zeros((1, 16, 4), jnp.int32), jnp.ones((1, 16, 4), bool))
     with pytest.raises(AssertionError, match='plain kNN'):
         model(feats, coors, mask, return_type=0, neighbors=nbr)
+
+
+def test_egnn_with_adjacency_edges():
+    """EGNN trunk consuming adjacency-degree edge embeddings (the padded
+    self-loop edge path, reference :910-911)."""
+    model = SE3Transformer(dim=8, depth=2, num_degrees=2, num_neighbors=0,
+                           use_egnn=True, attend_sparse_neighbors=True,
+                           max_sparse_neighbors=4, num_adj_degrees=2,
+                           adj_dim=4, seed=13)
+    rng, feats, coors, mask = _data()
+    i = np.arange(16)
+    adj = jnp.asarray(np.abs(i[:, None] - i[None, :]) == 1)
+    out = model(feats, coors, mask, adj_mat=adj, return_type=1)
+    assert out.shape == (1, 16, 8, 3)
+    assert np.isfinite(np.asarray(out)).all()
+
+    # equivariance holds through the edge-conditioned EGNN path
+    R = rot(0.4, 0.9, -0.2)
+    rot32 = lambda c: jnp.asarray(np.asarray(c, np.float64) @ R, F32)
+    out1 = model(feats, rot32(coors), mask, adj_mat=adj, return_type=1)
+    out2 = np.asarray(model(feats, coors, mask, adj_mat=adj, return_type=1),
+                      np.float64) @ R
+    assert np.abs(np.asarray(out1, np.float64) - out2).max() < 1e-4
+
+
+def test_dim_out_and_output_degrees():
+    model = SE3Transformer(dim=8, dim_out=5, depth=1, num_degrees=2,
+                           output_degrees=2, num_neighbors=4)
+    _, feats, coors, mask = _data()
+    out = model(feats, coors, mask)
+    assert out['0'].shape == (1, 16, 5)
+    assert out['1'].shape == (1, 16, 5, 3)

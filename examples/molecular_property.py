@@ -1,0 +1,112 @@
+"""Molecular property prediction — the edge-conditioned recipe end-to-end.
+
+Trains the BASELINE 'molecular_edges' recipe (atom tokens, bond-type edge
+tokens, sparse bonded attention via adjacency) to regress a synthetic
+per-molecule invariant target from a pooled type-0 readout. Demonstrates:
+
+  * the pooled invariant head (`return_pooled=True`),
+  * discrete edge tokens + adjacency-ring embeddings,
+  * the full train loop with the background input pipeline.
+
+Run: python examples/molecular_property.py [--steps N]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get('SE3_EXAMPLES_TPU') != '1':
+    jax.config.update('jax_platforms', 'cpu')
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from se3_transformer_tpu.models.se3_transformer import SE3TransformerModule
+from se3_transformer_tpu.native import chain_adjacency
+from se3_transformer_tpu.parallel import make_sharded_train_step
+from se3_transformer_tpu.training import BackgroundBatcher, prefetch_to_device
+
+NUM_ATOMS = 12
+NUM_TOKENS = 8
+NUM_BONDS = 3
+
+
+def build_batch(i: int) -> dict:
+    """Synthetic 'molecule': chain skeleton, random atoms/bonds; target =
+    a rotation-invariant function of geometry and composition."""
+    r = np.random.RandomState(i)
+    atoms = r.randint(0, NUM_TOKENS, (2, NUM_ATOMS))
+    coors = np.cumsum(r.normal(scale=0.7, size=(2, NUM_ATOMS, 3)), axis=1)
+    coors = (coors - coors.mean(1, keepdims=True)).astype(np.float32)
+    bonds = r.randint(0, NUM_BONDS, (2, NUM_ATOMS, NUM_ATOMS))
+    bonds = np.triu(bonds, 1) + np.triu(bonds, 1).transpose(0, 2, 1)
+    # invariant target: mean pairwise distance + atom-type mean
+    d = np.linalg.norm(coors[:, :, None] - coors[:, None, :], axis=-1)
+    target = d.mean((1, 2)) + atoms.mean(1) / NUM_TOKENS
+    return dict(atoms=jnp.asarray(atoms), coors=jnp.asarray(coors),
+                bonds=jnp.asarray(bonds),
+                target=jnp.asarray(target, jnp.float32))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=30)
+    args = ap.parse_args()
+
+    adj = jnp.asarray(chain_adjacency(NUM_ATOMS))
+    module = SE3TransformerModule(
+        num_tokens=NUM_TOKENS, num_edge_tokens=NUM_BONDS, edge_dim=4,
+        dim=16, depth=2, num_degrees=2, output_degrees=1, attend_self=True,
+        num_neighbors=4, attend_sparse_neighbors=True,
+        max_sparse_neighbors=4, num_adj_degrees=2, adj_dim=4)
+
+    b0 = build_batch(0)
+    mask = jnp.ones(b0['atoms'].shape, bool)
+
+    def forward(params, batch):
+        pooled = module.apply(
+            {'params': params}, batch['atoms'], batch['coors'], mask=mask,
+            adj_mat=adj, edges=batch['bonds'], return_pooled=True,
+            return_type=0)
+        return pooled.mean(-1)  # [B] invariant prediction
+
+    def loss_fn(params, batch, rng):
+        pred = forward(params, batch)
+        return ((pred - batch['target']) ** 2).mean(), {}
+
+    params = jax.jit(module.init, static_argnames=(
+        'return_type', 'return_pooled'))(
+        jax.random.PRNGKey(0), b0['atoms'], b0['coors'], mask=mask,
+        adj_mat=adj, edges=b0['bonds'], return_pooled=True,
+        return_type=0)['params']
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+    step = make_sharded_train_step(loss_fn, opt)
+
+    batcher = BackgroundBatcher(build_batch, capacity=4)
+    stream = prefetch_to_device(batcher, size=2)
+    key = jax.random.PRNGKey(0)
+    first = last = None
+    for i in range(args.steps):
+        batch = next(stream)
+        key, sub = jax.random.split(key)
+        params, opt_state, loss, _ = step(params, opt_state, batch, sub)
+        if i == 0:
+            first = float(loss)
+        last = float(loss)
+        if (i + 1) % 10 == 0:
+            print(f'step {i + 1}: mse {last:.4f}')
+    batcher.close()
+    if first is None:
+        print('no steps run')
+        return
+    print(f'mse {first:.4f} -> {last:.4f} '
+          f'({"improved" if last < first else "no improvement"})')
+
+
+if __name__ == '__main__':
+    main()

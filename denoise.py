@@ -30,6 +30,8 @@ def main():
     ap.add_argument('--mesh', action='store_true',
                     help='shard over all visible devices')
     ap.add_argument('--ckpt-dir', type=str, default=None)
+    ap.add_argument('--ckpt-every', type=int, default=0,
+                    help='also checkpoint every N steps (0 = only at exit)')
     ap.add_argument('--metrics', type=str, default=None)
     args = ap.parse_args()
 
@@ -49,7 +51,9 @@ def main():
 
     history = trainer.train(args.steps,
                             log=lambda msg: logger.log(trainer.step_count,
-                                                       msg=msg))
+                                                       msg=msg),
+                            checkpoint_manager=ckpt,
+                            checkpoint_every=args.ckpt_every)
     if ckpt is not None:
         ckpt.save(trainer.step_count,
                   (trainer.params, trainer.opt_state, trainer.step_count))

@@ -188,14 +188,24 @@ class DenoiseTrainer:
         return jax.tree_util.tree_map(
             lambda *vs: jnp.stack(vs), *batches)
 
-    def train(self, num_steps: int, log=print):
-        """Reference denoise.py:54-93 outer loop, with structured metrics."""
+    def train(self, num_steps: int, log=print, checkpoint_manager=None,
+              checkpoint_every: int = 0):
+        """Reference denoise.py:54-93 outer loop, with structured metrics.
+
+        With a CheckpointManager and checkpoint_every > 0, state is saved
+        periodically — the preemption-recovery story for TPU slices (the
+        CLI additionally saves at exit and resumes at start)."""
         history = []
         t0 = time.time()
         micro = max(1, self.cfg.accum_steps)
         for i in range(num_steps):
             batch = self.micro_batches()
             loss = self.train_step(batch)
+            if (checkpoint_manager is not None and checkpoint_every > 0
+                    and self.step_count % checkpoint_every == 0):
+                checkpoint_manager.save(
+                    self.step_count,
+                    (self.params, self.opt_state, self.step_count))
             if (i + 1) % self.cfg.log_every == 0:
                 loss = float(loss)  # host sync only at log interval
                 dt = time.time() - t0

@@ -114,3 +114,13 @@ def test_background_batcher_and_prefetch():
     batcher.close()
     assert seen == sorted(seen)  # in order
     assert len(set(seen)) == 5   # distinct batches
+
+
+def test_periodic_checkpointing(tmp_path):
+    mgr = CheckpointManager(os.path.join(tmp_path, 'ck'), max_to_keep=10)
+    cfg = DenoiseConfig(num_nodes=12, batch_size=1, num_degrees=2,
+                        max_sparse_neighbors=4)
+    trainer = DenoiseTrainer(cfg)
+    trainer.train(4, log=lambda *_: None, checkpoint_manager=mgr,
+                  checkpoint_every=2)
+    assert mgr.all_steps() == [2, 4]

@@ -1,0 +1,131 @@
+"""File-backed point-cloud datasets with length bucketing.
+
+The reference streams sidechainnet pickles and skips/truncates sequences
+in Python per step (denoise.py:15-19, 57-68). TPU-native constraints are
+different: shapes must be static per compiled program, so variable-length
+data is bucketed by length (one compilation per bucket) and padded by the
+native C++ batcher. This module provides:
+
+  * `save_point_cloud_dataset` / `PointCloudDataset` — a simple .npz
+    container (ragged sequences stored flat + offsets): tokens and
+    coords; `batches()` attaches the bucket's chain adjacency.
+  * `PointCloudDataset.batches(...)` — an iterator of padded, fixed-shape
+    batch dicts grouped by length bucket, ready for `BackgroundBatcher`/
+    `prefetch_to_device`.
+
+Swap in real data (e.g. a sidechainnet export) by writing the same .npz
+layout — no framework changes needed.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..native.loader import chain_adjacency, pad_batch
+
+
+def save_point_cloud_dataset(path: str, token_seqs: Sequence[np.ndarray],
+                             coord_seqs: Sequence[np.ndarray]) -> str:
+    """Store ragged (tokens [L], coords [L, 3]) sequences as one .npz."""
+    assert len(token_seqs) == len(coord_seqs)
+    for i, (t, c) in enumerate(zip(token_seqs, coord_seqs)):
+        c = np.asarray(c)
+        assert len(t) == c.reshape(-1, 3).shape[0], (
+            f'sequence {i}: {len(t)} tokens vs {c.reshape(-1, 3).shape[0]} '
+            f'coordinates — offsets are token-derived, a mismatch would '
+            f'silently mis-slice every later sequence')
+    lengths = np.asarray([len(t) for t in token_seqs], np.int64)
+    flat_tokens = np.concatenate(
+        [np.asarray(t, np.int32) for t in token_seqs]) if len(lengths) else \
+        np.zeros((0,), np.int32)
+    flat_coords = np.concatenate(
+        [np.asarray(c, np.float32).reshape(-1, 3) for c in coord_seqs]) \
+        if len(lengths) else np.zeros((0, 3), np.float32)
+    np.savez(path if path.endswith('.npz') else path + '.npz',
+             lengths=lengths, tokens=flat_tokens, coords=flat_coords)
+    return path if path.endswith('.npz') else path + '.npz'
+
+
+@dataclasses.dataclass
+class PointCloudDataset:
+    lengths: np.ndarray          # [S]
+    tokens: np.ndarray           # [sum L] int32
+    coords: np.ndarray           # [sum L, 3] float32
+
+    @classmethod
+    def load(cls, path: str) -> 'PointCloudDataset':
+        with np.load(path) as data:
+            return cls(lengths=data['lengths'].astype(np.int64),
+                       tokens=data['tokens'].astype(np.int32),
+                       coords=data['coords'].astype(np.float32))
+
+    def __len__(self) -> int:
+        return len(self.lengths)
+
+    def _offsets(self) -> np.ndarray:
+        return np.concatenate([[0], np.cumsum(self.lengths)])
+
+    def sequence(self, i: int):
+        off = self._offsets()
+        s, e = off[i], off[i + 1]
+        return self.tokens[s:e], self.coords[s:e]
+
+    def batches(self, batch_size: int,
+                buckets: Sequence[int] = (64, 128, 256, 512),
+                max_len: Optional[int] = None,
+                shuffle_seed: Optional[int] = 0,
+                drop_longer: bool = True,
+                with_chain_adjacency: bool = True) -> Iterator[dict]:
+        """Padded fixed-shape batches grouped by length bucket.
+
+        Each yielded dict: tokens [B, L], coords [B, L, 3], mask [B, L],
+        and (optionally) adj_mat [L, L] for the bucket's chain graph. L is
+        the bucket size, so each bucket compiles exactly once downstream.
+        Sequences longer than the largest bucket are dropped (the
+        reference skips >500-residue proteins the same way, denoise.py:15)
+        unless drop_longer=False, in which case they are truncated.
+
+        Fixed shapes require full batches, so each bucket's trailing
+        partial batch is dropped for that pass; vary `shuffle_seed` per
+        epoch (e.g. pass the epoch number) so different sequences land in
+        the remainder each time.
+        """
+        buckets = sorted(b for b in buckets
+                         if max_len is None or b <= max_len)
+        assert buckets, 'no usable buckets'
+        off = self._offsets()
+
+        by_bucket: List[List[int]] = [[] for _ in buckets]
+        for i, L in enumerate(self.lengths):
+            placed = False
+            for bi, b in enumerate(buckets):
+                if L <= b:
+                    by_bucket[bi].append(i)
+                    placed = True
+                    break
+            if not placed and not drop_longer:
+                by_bucket[-1].append(i)  # will be truncated to the bucket
+
+        rng = np.random.RandomState(shuffle_seed) \
+            if shuffle_seed is not None else None
+
+        for bi, idxs in enumerate(by_bucket):
+            if rng is not None:
+                idxs = list(rng.permutation(idxs))
+            L = buckets[bi]
+            adj = chain_adjacency(L) if with_chain_adjacency else None
+            for start in range(0, len(idxs) - batch_size + 1, batch_size):
+                chosen = idxs[start:start + batch_size]
+                toks, crds = [], []
+                for i in chosen:
+                    s, e = off[i], off[i + 1]
+                    toks.append(self.tokens[s:e][:L])
+                    crds.append(self.coords[s:e][:L])
+                tokens, coords, mask = pad_batch(toks, crds, max_len=L)
+                batch = dict(tokens=tokens, coords=coords, mask=mask,
+                             bucket=L)
+                if adj is not None:
+                    batch['adj_mat'] = adj
+                yield batch

@@ -33,6 +33,9 @@ def main():
     ap.add_argument('--ckpt-every', type=int, default=0,
                     help='also checkpoint every N steps (0 = only at exit)')
     ap.add_argument('--metrics', type=str, default=None)
+    ap.add_argument('--dataset', type=str, default=None,
+                    help='train from a PointCloudDataset .npz (see '
+                         'training.dataset); --nodes becomes the bucket size')
     args = ap.parse_args()
 
     cfg = DenoiseConfig(num_nodes=args.nodes, batch_size=args.batch,
@@ -49,11 +52,50 @@ def main():
         trainer.params, trainer.opt_state, trainer.step_count = state
         print(f'resumed from step {trainer.step_count}')
 
-    history = trainer.train(args.steps,
-                            log=lambda msg: logger.log(trainer.step_count,
-                                                       msg=msg),
-                            checkpoint_manager=ckpt,
-                            checkpoint_every=args.ckpt_every)
+    if args.dataset:
+        import itertools
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from se3_transformer_tpu.training.dataset import PointCloudDataset
+
+        ds = PointCloudDataset.load(args.dataset)
+
+        def file_batches():
+            for epoch in itertools.count():
+                yield from ds.batches(batch_size=cfg.batch_size,
+                                      buckets=(cfg.num_nodes,),
+                                      shuffle_seed=epoch)
+
+        stream = file_batches()
+        history = []
+        for i in range(args.steps):
+            b = next(stream)
+            n = b['tokens'].shape[1]
+            batch = dict(seqs=jnp.asarray(b['tokens']),
+                         coords=jnp.asarray(b['coords']),
+                         masks=jnp.asarray(b['mask']),
+                         adj_mat=jnp.asarray(
+                             np.broadcast_to(b['adj_mat'][None],
+                                             (cfg.batch_size, n, n)).copy()))
+            if cfg.accum_steps > 1:
+                batch = {k: jnp.stack([v] * cfg.accum_steps)
+                         for k, v in batch.items()}
+            loss = trainer.train_step(batch)
+            rec = logger.log(trainer.step_count, loss=float(loss))
+            history.append(rec)
+            if (ckpt is not None and args.ckpt_every > 0
+                    and trainer.step_count % args.ckpt_every == 0):
+                ckpt.save(trainer.step_count,
+                          (trainer.params, trainer.opt_state,
+                           trainer.step_count))
+    else:
+        history = trainer.train(args.steps,
+                                log=lambda msg: logger.log(
+                                    trainer.step_count, msg=msg),
+                                checkpoint_manager=ckpt,
+                                checkpoint_every=args.ckpt_every)
     if ckpt is not None:
         ckpt.save(trainer.step_count,
                   (trainer.params, trainer.opt_state, trainer.step_count))

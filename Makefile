@@ -1,0 +1,31 @@
+# Common entry points (see README.md for details)
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks clean-cache
+
+test:              ## full suite on the simulated 8-device CPU mesh
+	python -m pytest tests/ -q
+
+test-fast:         ## math/kernel/unit tests only (skips slow model suites)
+	python -m pytest tests/test_spherical_harmonics.py tests/test_wigner.py \
+	  tests/test_basis.py tests/test_ops.py tests/test_pallas.py \
+	  tests/test_native.py tests/test_ring.py -q
+
+bench:             ## one-line JSON benchmark (TPU if available, CPU fallback)
+	python bench.py
+
+denoise:           ## denoise training example
+	python denoise.py --steps 20
+
+cookbook:          ## every reference README usage pattern
+	python examples/cookbook.py
+
+molecular:         ## edge-conditioned molecular training example
+	python examples/molecular_property.py
+
+profile:           ## capture an xprof trace of a training step
+	python scripts/profile_model.py --cpu
+
+tpu-checks:        ## on-chip equivariance + kernel numerics/speed gate
+	python scripts/tpu_checks.py
+
+clean-cache:       ## wipe the Q_J and jit caches
+	rm -rf ~/.cache/se3_transformer_tpu

@@ -1,0 +1,148 @@
+"""Train every tracked BASELINE config for N steps and record throughput.
+
+One process, runs each recipe from training.recipes (BASELINE.json
+"configs") end to end: init, jitted denoise-style train steps, finite-loss
+assertion, and a throughput line per config. Writes a JSON summary.
+
+Usage: python scripts/run_baselines.py [--steps 8] [--out BASELINES.json]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def node_counts():
+    # per-config data scale: flagship gets the north-star 1024 nodes,
+    # stress configs enough nodes to exercise memory, toys stay toy
+    return dict(toy_denoise=96, flagship=1024, af2_refinement=256,
+                molecular_edges=128, egnn_stress=512)
+
+
+def run_config(name, module, n, steps, rng):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    needs_adj = bool(module.attend_sparse_neighbors or module.num_adj_degrees)
+    has_tokens = module.num_tokens is not None
+    b = 1
+
+    if has_tokens:
+        feats = jnp.asarray(rng.randint(0, module.num_tokens, (b, n)))
+    else:
+        feats = jnp.asarray(rng.normal(size=(b, n, module.dim)), jnp.float32)
+    coors = jnp.asarray(np.cumsum(rng.normal(size=(b, n, 3)), axis=1)
+                        .astype(np.float32))
+    coors = coors - coors.mean(axis=1, keepdims=True)
+    mask = jnp.ones((b, n), bool)
+    kwargs = dict(mask=mask)
+    if needs_adj:
+        i = np.arange(n)
+        kwargs['adj_mat'] = jnp.asarray(
+            np.broadcast_to((np.abs(i[:, None] - i[None, :]) == 1), (b, n, n))
+            .copy())
+    if module.num_edge_tokens is not None:
+        kwargs['edges'] = jnp.asarray(
+            rng.randint(0, module.num_edge_tokens, (b, n, n)))
+
+    # output convention per config: denoise-style refinement loss where
+    # the model emits a single type-1 vector per node (reduce_dim_out +
+    # output_degrees>=2); plain mean-square objective otherwise (scalar
+    # heads / EGNN multi-channel type-1)
+    if module.use_egnn:
+        return_type, denoise = 1, False
+    elif module.reduce_dim_out and (module.output_degrees or 0) >= 2:
+        return_type, denoise = 1, True
+    else:
+        return_type, denoise = 0, False
+
+    def loss_fn(params, coors, key):
+        noise = jax.random.normal(key, coors.shape, coors.dtype)
+        noised = coors + noise
+        out = module.apply({'params': params}, feats, noised,
+                           return_type=return_type, **kwargs)
+        if denoise:
+            return (((noised + out) - coors) ** 2).sum(-1).mean()
+        return (out ** 2).mean()
+
+    init = jax.jit(module.init, static_argnames=('return_type',))
+    params = init(jax.random.PRNGKey(0), feats, coors,
+                  return_type=return_type, **kwargs)['params']
+    opt = optax.adam(1e-4)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, key):
+        loss, grads = jax.value_and_grad(loss_fn)(params, coors, key)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    key = jax.random.PRNGKey(1)
+    t_c0 = time.time()
+    params, opt_state, loss = step(params, opt_state, key)
+    jax.block_until_ready(loss)
+    compile_s = time.time() - t_c0
+
+    t0 = time.time()
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        params, opt_state, loss = step(params, opt_state, sub)
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+    loss = float(loss)
+    assert np.isfinite(loss), f'{name}: non-finite loss'
+    return dict(config=name, nodes=n, steps=steps, loss=loss,
+                step_ms=round(dt / steps * 1e3, 2),
+                nodes_steps_per_sec=round(b * n * steps / dt, 2),
+                compile_s=round(compile_s, 1))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=8)
+    ap.add_argument('--configs', nargs='+', default=None)
+    ap.add_argument('--flagship-dim', type=int, default=64)
+    ap.add_argument('--out', type=str, default=None)
+    ap.add_argument('--cpu', action='store_true',
+                    help='force CPU (the axon TPU tunnel is single-client; '
+                         'use this when another process holds the chip)')
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update('jax_platforms', 'cpu')
+    import numpy as np
+
+    from se3_transformer_tpu.training.recipes import RECIPES
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    enable_compilation_cache()
+
+    backend = jax.default_backend()
+    print(f'backend: {backend}')
+    counts = node_counts()
+    results = []
+    names = args.configs or list(RECIPES)
+    for name in names:
+        builder = RECIPES[name]
+        module = builder(dim=args.flagship_dim) if name == 'flagship' \
+            else builder()
+        rng = np.random.RandomState(0)
+        rec = run_config(name, module, counts[name], args.steps, rng)
+        rec['backend'] = backend
+        print(json.dumps(rec))
+        results.append(rec)
+    if args.out:
+        with open(args.out, 'w') as f:
+            json.dump(results, f, indent=1)
+        print(f'wrote {args.out}')
+
+
+if __name__ == '__main__':
+    main()

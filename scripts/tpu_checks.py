@@ -39,6 +39,31 @@ def check_equivariance(precision: str):
     return err, err / max(scale, 1e-12)
 
 
+def check_equivariance_sparse_only(precision: str = 'float32'):
+    """The sparse-neighbors-only config: the reference runs its analogue in
+    float64 (tests/test_equivariance.py:234-260); on TPU there is no x64,
+    so this config needs its own f32 tolerance check on chip."""
+    from se3_transformer_tpu.utils.validation import equivariance_l2
+
+    module = SE3TransformerModule(
+        dim=16, depth=1, attend_self=True, num_degrees=2, output_degrees=2,
+        num_neighbors=0, attend_sparse_neighbors=True, num_adj_degrees=2,
+        adj_dim=4)
+    rng = np.random.RandomState(0)
+    n = 32
+    feats = jnp.asarray(rng.normal(size=(1, n, 16)), jnp.float32)
+    coors = jnp.asarray(rng.normal(size=(1, n, 3)), jnp.float32)
+    mask = jnp.ones((1, n), bool)
+    seq = np.arange(n)
+    adj = jnp.asarray((seq[:, None] >= seq[None, :] - 1)
+                      & (seq[:, None] <= seq[None, :] + 1))
+    with jax.default_matmul_precision(precision):
+        params = module.init(jax.random.PRNGKey(0), feats, coors, mask=mask,
+                             adj_mat=adj, return_type=1)['params']
+    return equivariance_l2(module, params, feats, coors, mask,
+                           precision=precision, adj_mat=adj)
+
+
 def bench_conv(pallas: bool, n=512, k=24, dim=32, degrees=3, iters=10):
     from se3_transformer_tpu.basis import get_basis
     from se3_transformer_tpu.ops import ConvSE3, Fiber
@@ -123,6 +148,10 @@ def main():
         status = 'PASS' if (prec != 'float32' or err < 1e-4) else 'FAIL'
         print(f'equivariance @ matmul_precision={prec}: abs={err:.2e} '
               f'rel={rel:.2e} [{status if prec == "float32" else "info"}]')
+
+    err_sp = check_equivariance_sparse_only()
+    print(f'equivariance sparse-only @ f32: abs={err_sp:.2e} '
+          f'[{"PASS" if err_sp < 1e-4 else "FAIL"}]')
 
     gworst = check_fused_backward()
     print(f'fused bwd vs XLA grads: rel={gworst:.2e} '

@@ -1,0 +1,67 @@
+"""One-shot patient TPU session: wait for the chip, validate, benchmark.
+
+The axon tunnel is single-client and wedges when a claim-holding process
+is killed. So this script NEVER times itself out: if the chip is busy or
+wedged it blocks harmlessly at backend init (a blocked waiter holds no
+claim) and proceeds the moment the lease frees up. Once it has the chip
+it runs the full on-chip suite in ONE process — tpu_checks (equivariance
+at f32/bf16, fused Pallas kernel numerics + speedup) and then the
+flagship benchmark — and exits cleanly so the chip is released.
+
+Usage: python scripts/tpu_session.py [logfile]
+"""
+import datetime
+import os
+import sys
+import traceback
+
+LOG = sys.argv[1] if len(sys.argv) > 1 else '/tmp/tpu_session.log'
+
+
+def log(msg):
+    stamp = datetime.datetime.utcnow().strftime('%H:%M:%S')
+    line = f'[{stamp}] {msg}'
+    print(line, flush=True)
+    with open(LOG, 'a') as f:
+        f.write(line + '\n')
+
+
+def main():
+    log(f'pid={os.getpid()} waiting for TPU (blocking, no timeout)...')
+    import jax
+    devs = jax.devices()
+    log(f'devices: {devs}')
+    if jax.default_backend() != 'tpu':
+        log('backend is not tpu — aborting (nothing to validate)')
+        return 1
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(here))  # repo root (bench, package)
+    sys.path.insert(0, here)                   # scripts/ (tpu_checks)
+
+    failed = False
+
+    log('--- tpu_checks ---')
+    try:
+        import tpu_checks as tc
+        tc.main()
+        log('tpu_checks: completed')
+    except Exception:
+        failed = True
+        log('tpu_checks FAILED:\n' + traceback.format_exc())
+
+    log('--- flagship bench ---')
+    try:
+        import bench
+        bench.main('tpu')
+        log('bench: completed')
+    except Exception:
+        failed = True
+        log('bench FAILED:\n' + traceback.format_exc())
+
+    log(f'session done ({"FAILED" if failed else "ok"}), releasing chip')
+    return 2 if failed else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -119,6 +119,12 @@ class SE3TransformerModule(nn.Module):
     # stream the node axis through the pairwise contraction in N chunks
     # (XLA path; memory ceiling for huge channel counts)
     edge_chunks: Optional[int] = None
+    # 'ring' = sequence-parallel neighbor selection: exact kNN via a ring
+    # of ppermutes over `mesh`'s sp axis (parallel.ring), so the O(N^2)
+    # distance/top-k tensors of the dense path (reference :1222) never
+    # exist on any device. Requires `mesh`; plain-kNN semantics only.
+    sequence_parallel: Optional[str] = None
+    mesh: Optional[jax.sharding.Mesh] = None
 
     # ------------------------------------------------------------------ #
     # static configuration helpers (resolved at trace time)
@@ -214,6 +220,21 @@ class SE3TransformerModule(nn.Module):
             'either attend to sparse neighbors or use num_neighbors > 0'
         num_neighbors = int(min(num_neighbors, n - 1))
 
+        # sequence-parallel ring kNN: neighbor selection runs under
+        # shard_map over the sp mesh axis (peak memory O(n_local^2), ICI
+        # ppermute ring) and feeds the precomputed-neighbors path below —
+        # all in one traced program, no host round-trip
+        if precomputed_neighbors is None and self.sequence_parallel is not None:
+            assert self.sequence_parallel == 'ring', \
+                f"unknown sequence_parallel mode {self.sequence_parallel!r}"
+            assert self.mesh is not None, \
+                'sequence_parallel requires a mesh (jax.sharding.Mesh)'
+            assert num_neighbors > 0, \
+                'sequence_parallel needs num_neighbors > 0'
+            from ..parallel.ring import FINF as _FINF, ring_knn
+            dist, idx = ring_knn(coors, num_neighbors, self.mesh, mask=mask)
+            precomputed_neighbors = (idx, dist < _FINF)
+
         # precomputed neighborhoods (host C++ kNN via native.knn_graph, or
         # ring kNN via parallel.ring) replace the O(n^2) on-device
         # selection entirely — handled before any O(n^2) index tensors are
@@ -268,9 +289,15 @@ class SE3TransformerModule(nn.Module):
             adj_noself = remove_self(adj_mat, self_excl)
             max_sparse = self.max_sparse_neighbors
             num_sparse = int(min(max_sparse, n - 1))
+            # tie-break jitter: fresh per call when the caller threads an
+            # rng (apply(..., rngs={'neighbor_noise': key}), matching the
+            # reference's per-forward draw at se3_transformer_pytorch.py
+            # :1211); deterministic seed-0 otherwise so plain inference
+            # stays reproducible
+            noise_key = self.make_rng('neighbor_noise') \
+                if self.has_rng('neighbor_noise') else jax.random.PRNGKey(0)
             noise = jax.random.uniform(
-                jax.random.PRNGKey(0), adj_noself.shape,
-                minval=-0.01, maxval=0.01)
+                noise_key, adj_noself.shape, minval=-0.01, maxval=0.01)
             sparse_mask = sparse_neighbor_mask(adj_noself, num_sparse, noise)
 
         # pairwise geometry, self-excluded by construction (reference :1221-1229)

@@ -49,8 +49,10 @@ class RadialFunc(nn.Module):
     """Per-edge radial profile MLP (reference :270-299).
 
     edge scalar features [..., edge_dim+1] -> R [..., c_out, c_in, num_freq].
-    Kept for API parity / inspection; PairwiseConvSE3 holds the same
-    parameters but fuses the final matmul into the pairwise contraction.
+    This is the unfused formulation: PairwiseConvSE3 uses it when
+    `fused=False` (reference-ordered contraction, numerics oracle for the
+    fused path — see tests/test_ops.py) and holds the equivalent
+    parameters in fused [mid, c_in*F, c_out] layout otherwise.
     """
     num_freq: int
     in_dim: int
@@ -119,6 +121,10 @@ class PairwiseConvSE3(nn.Module):
     # (lax.map + remat): bounds peak memory to O(E/edge_chunks * c_in *
     # c_out * F) for huge configs (e.g. dim-512 flagship). None = off.
     edge_chunks: Optional[int] = None
+    # False = reference-ordered unfused path through RadialFunc (per-edge
+    # [c_out, c_in, F] kernel tensors, reference :326-343); the numerics
+    # oracle for the fused paths above. Param layout differs.
+    fused: bool = True
 
     @nn.compact
     def __call__(self, edge_feats: jnp.ndarray, basis_slice: jnp.ndarray,
@@ -130,6 +136,12 @@ class PairwiseConvSE3(nn.Module):
         F = to_order(min(self.degree_in, self.degree_out))
         P = to_order(self.degree_out)
         IF = self.nc_in * F
+
+        if not self.fused:
+            R = RadialFunc(num_freq=F, in_dim=self.nc_in,
+                           out_dim=self.nc_out, mid_dim=self.mid_dim,
+                           name='radial')(edge_feats)
+            return pairwise_conv_contract(R, basis_slice, x)
 
         h = hidden if hidden is not None \
             else radial_hidden(edge_feats, self.mid_dim)     # [b,n,k,mid]

@@ -3,4 +3,5 @@ from . import distributed
 from .ring import ring_knn, dense_knn
 from .sharding import (
     make_sharded_train_step, make_accumulating_train_step, replicated,
+    param_partition_specs, shard_params,
 )

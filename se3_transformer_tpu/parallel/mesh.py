@@ -20,6 +20,7 @@ the same mesh covers multi-host (ICI intra-slice, DCN across slices).
 from __future__ import annotations
 
 import math
+import warnings
 from typing import Optional, Sequence
 
 import jax
@@ -51,10 +52,16 @@ def make_mesh(devices: Optional[Sequence] = None,
               tp: Optional[int] = None) -> Mesh:
     """Build a ('dp', 'sp', 'tp') mesh over the given (or all) devices.
 
-    Unspecified axis sizes are auto-factorized from the device count.
+    Unspecified axis sizes are auto-factorized from the device count —
+    except tp, which defaults to 1 unless explicitly requested: tensor
+    parallelism only does real work when the caller also partitions the
+    params (parallel.sharding.shard_params), so auto-allocating devices
+    to tp would silently make them redundant.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+    if tp is None:
+        tp = 1
     known = [a for a in (dp, sp, tp) if a is not None]
     rest = n // math.prod(known) if known else n
     if dp is None or sp is None or tp is None:
@@ -94,7 +101,9 @@ def shard_batch(batch: dict, mesh: Mesh, leading_axes: int = 0) -> dict:
     `leading_axes` extra leading dims (e.g. a gradient-accumulation axis)
     are left unsharded. Axes that do not divide evenly by their mesh axis
     fall back to replication for that dimension (e.g. batch_size=1 with
-    dp>1), so any batch is placeable."""
+    dp>1), so any batch is placeable — but the fallback is LOUD: silently
+    replicating would make "sharded training" mean "every device does the
+    same work", so each degraded (key, dim) pair warns once."""
     specs = data_specs()
     out = {}
     for k, v in batch.items():
@@ -107,6 +116,16 @@ def shard_batch(batch: dict, mesh: Mesh, leading_axes: int = 0) -> dict:
                 fixed.append(None)
                 continue
             size = mesh.shape[axis] if isinstance(axis, str) else 1
-            fixed.append(axis if v.shape[d] % size == 0 else None)
+            if v.shape[d] % size == 0:
+                fixed.append(axis)
+            else:
+                fixed.append(None)
+                if size > 1:
+                    warnings.warn(
+                        f"shard_batch: '{k}' dim {d} (size {v.shape[d]}) "
+                        f"does not divide mesh axis '{axis}' (size {size}) "
+                        f"— replicating that dimension instead; those "
+                        f"devices will do redundant work",
+                        stacklevel=2)
         out[k] = jax.device_put(v, NamedSharding(mesh, P(*fixed)))
     return out

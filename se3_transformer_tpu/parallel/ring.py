@@ -31,10 +31,12 @@ from ..ops.neighbors import FINF
 
 
 def _ring_knn_local(coors_q: jnp.ndarray, coors_src: jnp.ndarray,
+                    mask_src: jnp.ndarray,
                     k: int, axis_name: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-shard body (runs under shard_map). coors_q/coors_src are this
-    device's [b, nl, 3] blocks. Returns (dist [b, nl, k], idx [b, nl, k])
-    with idx in GLOBAL node coordinates."""
+    device's [b, nl, 3] blocks, mask_src its [b, nl] source validity.
+    Returns (dist [b, nl, k], idx [b, nl, k]) with idx in GLOBAL node
+    coordinates; masked-out sources never occupy a neighbor slot."""
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, nl, _ = coors_q.shape
@@ -46,17 +48,18 @@ def _ring_knn_local(coors_q: jnp.ndarray, coors_src: jnp.ndarray,
     best_i = jax.lax.pcast(best_i, (axis_name,), to='varying')
 
     def step(carry, t):
-        best_d, best_i, src = carry
+        best_d, best_i, src, m_src = carry
         # at ring step t, this device holds the block originally owned by
         # (my_idx + t) mod axis_size
         src_owner = (my_idx + t) % axis_size
         # distances to the current source block
         d = jnp.linalg.norm(coors_q[:, :, None] - src[:, None, :], axis=-1)
         src_global = src_owner * nl + jnp.arange(nl, dtype=jnp.int32)
-        # exclude self-pairs (same global id)
+        # exclude self-pairs (same global id) and masked-out sources
         q_global = my_idx * nl + jnp.arange(nl, dtype=jnp.int32)
         self_mask = q_global[:, None] == src_global[None, :]
         d = jnp.where(self_mask[None], FINF, d)
+        d = jnp.where(m_src[:, None, :], d, FINF)
 
         cand_d = jnp.concatenate([best_d, d], axis=-1)
         cand_i = jnp.concatenate(
@@ -70,33 +73,40 @@ def _ring_knn_local(coors_q: jnp.ndarray, coors_src: jnp.ndarray,
         # the block from device i+1 over ICI)
         perm = [(i, (i - 1) % axis_size) for i in range(axis_size)]
         src = jax.lax.ppermute(src, axis_name, perm)
-        return (new_d, new_i, src), None
+        m_src = jax.lax.ppermute(m_src, axis_name, perm)
+        return (new_d, new_i, src, m_src), None
 
-    init = (best_d, best_i, coors_q)
-    (best_d, best_i, _), _ = jax.lax.scan(
+    init = (best_d, best_i, coors_q, mask_src)
+    (best_d, best_i, _, _), _ = jax.lax.scan(
         step, init, jnp.arange(axis_size, dtype=jnp.int32))
     return best_d, best_i
 
 
 def ring_knn(coors: jnp.ndarray, k: int, mesh: Mesh,
-             axis_name: str = 'sp') -> Tuple[jnp.ndarray, jnp.ndarray]:
+             axis_name: str = 'sp',
+             mask: Optional[jnp.ndarray] = None
+             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Exact kNN (self excluded) over a node-sharded coordinate tensor.
 
-    coors [b, n, 3] with n divisible by mesh.shape[axis_name]. Returns
-    (dist [b, n, k], idx [b, n, k]) sharded the same way; indices are
-    global node ids.
+    coors [b, n, 3] with n divisible by mesh.shape[axis_name]; optional
+    mask [b, n] excludes padded nodes from ever being selected as
+    sources. Returns (dist [b, n, k], idx [b, n, k]) sharded the same
+    way; indices are global node ids and invalid slots carry dist=FINF.
     """
     n = coors.shape[1]
     sp = mesh.shape[axis_name]
     assert n % sp == 0, f'n={n} must divide over {axis_name}={sp}'
+    if mask is None:
+        mask = jnp.ones(coors.shape[:2], bool)
 
     spec = P(None, axis_name, None)
+    mspec = P(None, axis_name)
     fn = jax.shard_map(
         partial(_ring_knn_local, k=k, axis_name=axis_name),
         mesh=mesh,
-        in_specs=(spec, spec),
+        in_specs=(spec, spec, mspec),
         out_specs=(spec, spec))
-    return fn(coors, coors)
+    return fn(coors, coors, mask)
 
 
 def dense_knn(coors: jnp.ndarray, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:

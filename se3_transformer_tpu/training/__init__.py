@@ -5,4 +5,5 @@ from .denoise import (
 from .checkpoint import CheckpointManager
 from .data import BackgroundBatcher, prefetch_to_device
 from .dataset import PointCloudDataset, save_point_cloud_dataset
+from .sidechainnet import convert_sidechainnet
 from .recipes import RECIPES

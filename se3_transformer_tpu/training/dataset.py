@@ -27,15 +27,23 @@ from ..native.loader import chain_adjacency, pad_batch
 
 
 def save_point_cloud_dataset(path: str, token_seqs: Sequence[np.ndarray],
-                             coord_seqs: Sequence[np.ndarray]) -> str:
-    """Store ragged (tokens [L], coords [L, 3]) sequences as one .npz."""
+                             coord_seqs: Sequence[np.ndarray],
+                             mask_seqs: Optional[Sequence[np.ndarray]] = None
+                             ) -> str:
+    """Store ragged (tokens [L], coords [L, 3], optional mask [L])
+    sequences as one .npz. Masks mark unresolved nodes (e.g. residues a
+    sidechainnet entry could not place); omitted = all valid."""
     assert len(token_seqs) == len(coord_seqs)
+    if mask_seqs is not None:
+        assert len(mask_seqs) == len(token_seqs)
     for i, (t, c) in enumerate(zip(token_seqs, coord_seqs)):
         c = np.asarray(c)
         assert len(t) == c.reshape(-1, 3).shape[0], (
             f'sequence {i}: {len(t)} tokens vs {c.reshape(-1, 3).shape[0]} '
             f'coordinates — offsets are token-derived, a mismatch would '
             f'silently mis-slice every later sequence')
+        if mask_seqs is not None:
+            assert len(mask_seqs[i]) == len(t), f'sequence {i}: mask length'
     lengths = np.asarray([len(t) for t in token_seqs], np.int64)
     flat_tokens = np.concatenate(
         [np.asarray(t, np.int32) for t in token_seqs]) if len(lengths) else \
@@ -43,8 +51,12 @@ def save_point_cloud_dataset(path: str, token_seqs: Sequence[np.ndarray],
     flat_coords = np.concatenate(
         [np.asarray(c, np.float32).reshape(-1, 3) for c in coord_seqs]) \
         if len(lengths) else np.zeros((0, 3), np.float32)
-    np.savez(path if path.endswith('.npz') else path + '.npz',
-             lengths=lengths, tokens=flat_tokens, coords=flat_coords)
+    arrays = dict(lengths=lengths, tokens=flat_tokens, coords=flat_coords)
+    if mask_seqs is not None:
+        arrays['masks'] = np.concatenate(
+            [np.asarray(m, bool) for m in mask_seqs]) if len(lengths) else \
+            np.zeros((0,), bool)
+    np.savez(path if path.endswith('.npz') else path + '.npz', **arrays)
     return path if path.endswith('.npz') else path + '.npz'
 
 
@@ -52,14 +64,17 @@ def save_point_cloud_dataset(path: str, token_seqs: Sequence[np.ndarray],
 class PointCloudDataset:
     lengths: np.ndarray          # [S]
     tokens: np.ndarray           # [sum L] int32
-    coords: np.ndarray           # [sum L, 3] float32
+    coords: np.ndarray          # [sum L, 3] float32
+    masks: Optional[np.ndarray] = None  # [sum L] bool, None = all valid
 
     @classmethod
     def load(cls, path: str) -> 'PointCloudDataset':
         with np.load(path) as data:
             return cls(lengths=data['lengths'].astype(np.int64),
                        tokens=data['tokens'].astype(np.int32),
-                       coords=data['coords'].astype(np.float32))
+                       coords=data['coords'].astype(np.float32),
+                       masks=(data['masks'].astype(bool)
+                              if 'masks' in data else None))
 
     def __len__(self) -> int:
         return len(self.lengths)
@@ -124,6 +139,12 @@ class PointCloudDataset:
                     toks.append(self.tokens[s:e][:L])
                     crds.append(self.coords[s:e][:L])
                 tokens, coords, mask = pad_batch(toks, crds, max_len=L)
+                if self.masks is not None:
+                    # padding mask AND per-node resolution mask
+                    for row, i in enumerate(chosen):
+                        s, e = off[i], off[i + 1]
+                        m = self.masks[s:e][:L]
+                        mask[row, :len(m)] &= m
                 batch = dict(tokens=tokens, coords=coords, mask=mask,
                              bucket=L)
                 if adj is not None:

@@ -62,6 +62,9 @@ class DenoiseConfig:
     # infra
     seed: int = 0
     use_mesh: bool = False
+    # partition radial/head weights over the mesh's tp axis (see
+    # parallel.sharding.param_partition_specs); requires a mesh with tp>1
+    tensor_parallel: bool = False
     log_every: int = 1
 
     def build_module(self) -> SE3TransformerModule:
@@ -127,14 +130,25 @@ class DenoiseTrainer:
             make_mesh() if cfg.use_mesh else None)
         self.optimizer = optax.adam(cfg.learning_rate)
         self.loss_fn = denoise_loss_fn(self.module)
+        self.tensor_parallel = bool(cfg.tensor_parallel
+                                    and self.mesh is not None)
+        if cfg.tensor_parallel and (
+                self.mesh is None or self.mesh.shape.get('tp', 1) == 1):
+            import warnings
+            warnings.warn(
+                'tensor_parallel=True but the mesh has no tp axis '
+                '(make_mesh defaults tp=1) — params will be fully '
+                'replicated; build the mesh with make_mesh(tp=...) to '
+                'actually partition them', stacklevel=2)
         if cfg.accum_steps > 1:
             # reference denoise.py:13,55: 16 micro-batches per update
             self._step_fn = make_accumulating_train_step(
                 self.loss_fn, self.optimizer, cfg.accum_steps,
-                mesh=self.mesh)
+                mesh=self.mesh, tensor_parallel=self.tensor_parallel)
         else:
             self._step_fn = make_sharded_train_step(
-                self.loss_fn, self.optimizer, mesh=self.mesh)
+                self.loss_fn, self.optimizer, mesh=self.mesh,
+                tensor_parallel=self.tensor_parallel)
         self.np_rng = np.random.RandomState(cfg.seed)
         self.rng = jax.random.PRNGKey(cfg.seed)
         self.params = None
@@ -151,7 +165,14 @@ class DenoiseTrainer:
         self.params = init_fn(
             sub, batch['seqs'], noised, mask=batch['masks'],
             adj_mat=batch['adj_mat'], return_type=1)['params']
-        self.opt_state = self.optimizer.init(self.params)
+        if self.tensor_parallel:
+            from ..parallel.sharding import shard_params
+            self.params = shard_params(self.params, self.mesh)
+            # jit so the adam moments inherit the param placement (eager
+            # zeros_like would leave them uncommitted/replicated)
+            self.opt_state = jax.jit(self.optimizer.init)(self.params)
+        else:
+            self.opt_state = self.optimizer.init(self.params)
         return self.params
 
     def train_step(self, batch) -> float:

@@ -140,3 +140,39 @@ def test_neighborhood_mask_radius():
     m = np.asarray(hood.mask)
     assert (d[m] <= 1.0).all()
     assert (d[~m] > 1.0).all()
+
+
+def test_radial_func_unfused_matches_fused():
+    """RadialFunc (reference-ordered unfused path, fused=False) and the
+    fused w3/b3 contraction are the same function: transplanting the
+    unfused Dense params into the fused layout reproduces the output."""
+    from se3_transformer_tpu.ops.conv import PairwiseConvSE3
+
+    rng = np.random.RandomState(0)
+    b, n, k, ci, co, di, do = 1, 6, 4, 3, 5, 2, 1
+    F = 2 * min(di, do) + 1
+    edge_feats = jnp.asarray(rng.normal(size=(b, n, k, 1)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(b, n, k, ci, 2 * di + 1)), jnp.float32)
+    basis = jnp.asarray(
+        rng.normal(size=(b, n, k, 2 * do + 1, 2 * di + 1, F)), jnp.float32)
+
+    unfused = PairwiseConvSE3(di, ci, do, co, mid_dim=16, fused=False)
+    fused = PairwiseConvSE3(di, ci, do, co, mid_dim=16, pallas=False)
+
+    p_u = unfused.init(jax.random.PRNGKey(0), edge_feats, basis, x)['params']
+    out_u = unfused.apply({'params': p_u}, edge_feats, basis, x)
+
+    radial = p_u['radial']
+    K = np.asarray(radial['Dense_2']['kernel'])          # [mid, O*I*F]
+    bias = np.asarray(radial['Dense_2']['bias'])         # [O*I*F]
+    mid = K.shape[0]
+    w3 = K.reshape(mid, co, ci, F).transpose(0, 2, 3, 1).reshape(
+        mid, ci * F, co)
+    b3 = bias.reshape(co, ci, F).transpose(1, 2, 0).reshape(ci * F, co)
+    p_f = {k_: radial[k_] for k_ in
+           ('Dense_0', 'LayerNorm_0', 'Dense_1', 'LayerNorm_1')}
+    p_f['w3'] = jnp.asarray(w3)
+    p_f['b3'] = jnp.asarray(b3)
+    out_f = fused.apply({'params': p_f}, edge_feats, basis, x)
+
+    assert np.abs(np.asarray(out_u) - np.asarray(out_f)).max() < 1e-5

@@ -59,3 +59,78 @@ def test_ring_knn_feeds_model():
     out_ring = model(feats, coors, mask, return_type=1,
                      neighbors=(idx, dist <= 1e5))
     assert np.abs(np.asarray(out_internal) - np.asarray(out_ring)).max() < 2e-5
+
+
+def test_ring_knn_respects_mask():
+    rng = np.random.RandomState(3)
+    coors = jnp.asarray(rng.normal(size=(1, 32, 3)), jnp.float32)
+    mask = np.ones((1, 32), bool)
+    mask[:, 24:] = False  # padded tail
+    mesh = make_mesh(dp=1, sp=8, tp=1)
+    d, i = ring_knn(coors, 4, mesh, mask=jnp.asarray(mask))
+    # masked-out sources never appear as neighbors of valid queries
+    i_valid = np.asarray(i)[:, :24]
+    assert (i_valid < 24).all()
+
+
+def test_sequence_parallel_ring_model_matches_dense():
+    """sequence_parallel='ring': neighbor selection under shard_map inside
+    the traced forward; output matches the dense internal-selection path."""
+    import jax
+    from se3_transformer_tpu import SE3TransformerModule
+
+    rng = np.random.RandomState(4)
+    n, k = 64, 6
+    feats = jnp.asarray(rng.normal(size=(1, n, 8)), jnp.float32)
+    coors = jnp.asarray(rng.normal(size=(1, n, 3)), jnp.float32)
+    mask = jnp.ones((1, n), bool)
+
+    mesh = make_mesh(dp=1, sp=8, tp=1)
+    kw = dict(dim=8, depth=1, attend_self=True, num_neighbors=k,
+              num_degrees=2, output_degrees=2)
+    dense = SE3TransformerModule(**kw)
+    ring = SE3TransformerModule(**kw, sequence_parallel='ring', mesh=mesh)
+
+    params = dense.init(jax.random.PRNGKey(7), feats, coors, mask=mask,
+                        return_type=1)['params']
+    out_d = dense.apply({'params': params}, feats, coors, mask=mask,
+                        return_type=1)
+    out_r = jax.jit(lambda p, f, c, m: ring.apply(
+        {'params': p}, f, c, mask=m, return_type=1))(params, feats, coors,
+                                                     mask)
+    assert np.abs(np.asarray(out_d) - np.asarray(out_r)).max() < 2e-5
+
+
+def test_sequence_parallel_ring_long_context():
+    """n=4096 node-sharded forward: the ring path never materializes an
+    O(N^2) tensor; runs where the dense path's [b, n, n-1, 3] rel_pos
+    (~200 MB + top_k over it) would blow past a TPU core's HBM slice."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from se3_transformer_tpu import SE3TransformerModule
+
+    rng = np.random.RandomState(5)
+    n, k = 4096, 8
+    feats = jnp.asarray(rng.normal(size=(1, n, 8)), jnp.float32)
+    coors = jnp.asarray(rng.normal(size=(1, n, 3)) * 5, jnp.float32)
+    mask = jnp.ones((1, n), bool)
+
+    mesh = make_mesh(dp=1, sp=8, tp=1)
+    module = SE3TransformerModule(dim=8, depth=1, attend_self=True,
+                                  num_neighbors=k, num_degrees=2,
+                                  output_degrees=2,
+                                  sequence_parallel='ring', mesh=mesh)
+    # node-sharded inputs, as in production
+    feats = jax.device_put(feats, NamedSharding(mesh, P(None, 'sp', None)))
+    coors = jax.device_put(coors, NamedSharding(mesh, P(None, 'sp', None)))
+    mask = jax.device_put(mask, NamedSharding(mesh, P(None, 'sp')))
+
+    params = jax.jit(module.init, static_argnames=('return_type',))(
+        jax.random.PRNGKey(0), feats, coors, mask=mask,
+        return_type=1)['params']
+    out = jax.jit(lambda p, f, c, m: module.apply(
+        {'params': p}, f, c, mask=m, return_type=1))(params, feats, coors,
+                                                     mask)
+    out = np.asarray(out)
+    assert out.shape == (1, n, 8, 3)
+    assert np.isfinite(out).all()

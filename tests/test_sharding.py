@@ -1,4 +1,5 @@
 """Multi-device SPMD tests on the simulated 8-device CPU mesh."""
+import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -64,3 +65,59 @@ def test_pod_mesh_cpu_fallback():
     mesh = distributed.pod_mesh(dp=2, sp=2, tp=2)
     assert mesh.devices.size == 8
     assert mesh.axis_names == ('dp', 'sp', 'tp')
+
+
+def test_shard_batch_warns_on_replication_fallback():
+    import warnings
+    mesh = make_mesh(dp=4, sp=2, tp=1)
+    batch = dict(feats=jnp.zeros((3, 16)))  # 3 % dp=4 != 0
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        shard_batch(batch, mesh)
+    assert any('redundant work' in str(x.message) for x in w)
+
+    # clean divisions stay silent
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        shard_batch(dict(feats=jnp.zeros((4, 16))), mesh)
+    assert not w
+
+
+def test_tensor_parallel_params_partitioned_and_match_replicated():
+    """tp is real: radial w3 / attention-head weights are actually
+    partitioned over the tp axis, stay partitioned through an update, and
+    the numerics match the replicated path."""
+    from se3_transformer_tpu.parallel import param_partition_specs, shard_params
+
+    cfg = DenoiseConfig(num_nodes=24, batch_size=2, num_degrees=2,
+                        max_sparse_neighbors=4, seed=3)
+    batch = synthetic_protein_batch(cfg, np.random.RandomState(0))
+
+    mesh_r = make_mesh(dp=2, sp=2, tp=2)
+    repl = DenoiseTrainer(cfg, mesh=mesh_r)
+    loss_repl = float(repl.train_step(batch))
+
+    cfg_tp = dataclasses.replace(cfg, tensor_parallel=True)
+    tp = DenoiseTrainer(cfg_tp, mesh=mesh_r)
+    loss_tp = float(tp.train_step(batch))
+
+    # numerics agree with the replicated path
+    assert np.isfinite(loss_tp)
+    assert abs(loss_repl - loss_tp) < 1e-4 * max(1.0, abs(loss_repl))
+    for a, b in zip(jax.tree_util.tree_leaves(repl.params),
+                    jax.tree_util.tree_leaves(tp.params)):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+    # params are ACTUALLY partitioned (not cosmetic), before and after
+    # the update
+    n_sharded = 0
+    flat_p = jax.tree_util.tree_flatten_with_path(tp.params)[0]
+    for path, leaf in flat_p:
+        spec = leaf.sharding.spec if hasattr(leaf.sharding, 'spec') else None
+        if spec and 'tp' in [s for s in spec if isinstance(s, str)]:
+            n_sharded += 1
+            ax = list(spec).index('tp')
+            # each tp shard holds 1/tp of the axis
+            shard_shapes = {s.data.shape for s in leaf.addressable_shards}
+            assert all(sh[ax] == leaf.shape[ax] // 2 for sh in shard_shapes)
+    assert n_sharded >= 4, f'only {n_sharded} params tp-sharded'

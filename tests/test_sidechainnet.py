@@ -1,0 +1,89 @@
+"""Sidechainnet-format converter -> .npz dataset -> training run."""
+import numpy as np
+import pytest
+
+from se3_transformer_tpu.training import PointCloudDataset, convert_sidechainnet
+from se3_transformer_tpu.training.sidechainnet import (
+    ATOMS_PER_RESIDUE, BACKBONE_ATOMS, UNK_ID, tokenize_sequence,
+)
+
+
+def _fake_scn(n_train=12, lmin=10, lmax=21, seed=0):
+    """Synthetic dict in the sidechainnet pickle layout: seq strings,
+    [14L, 3] all-atom coords, '+'/'-' resolution masks."""
+    rng = np.random.RandomState(seed)
+    aas = 'ACDEFGHIKLMNPQRSTVWYX'
+    split = dict(seq=[], crd=[], msk=[])
+    for _ in range(n_train):
+        L = rng.randint(lmin, lmax)
+        split['seq'].append(''.join(rng.choice(list(aas), L)))
+        steps = rng.normal(size=(L, 3))
+        ca = np.cumsum(1.2 * steps / np.linalg.norm(steps, -1, keepdims=True),
+                       axis=0)
+        crd = ca[:, None, :] + 0.3 * rng.normal(size=(L, ATOMS_PER_RESIDUE, 3))
+        msk = rng.choice(['+', '-'], L, p=[0.9, 0.1])
+        crd[msk == '-'] = 0.  # sidechainnet zero-fills unresolved residues
+        split['crd'].append(crd.reshape(-1, 3).astype(np.float32))
+        split['msk'].append(''.join(msk))
+    return {'train': split}
+
+
+def test_convert_and_load(tmp_path):
+    data = _fake_scn()
+    path = convert_sidechainnet(data, str(tmp_path / 'scn.npz'))
+    ds = PointCloudDataset.load(path)
+    assert len(ds) == 12
+    # 3 nodes per residue, tokens repeated, masks carried through
+    t0, c0 = ds.sequence(0)
+    L0 = len(data['train']['seq'][0])
+    assert len(t0) == L0 * BACKBONE_ATOMS
+    assert (t0[:3] == tokenize_sequence(data['train']['seq'][0][0])[0]).all()
+    assert ds.masks is not None
+    resolved0 = np.asarray([c == '+' for c in data['train']['msk'][0]])
+    np.testing.assert_array_equal(
+        ds.masks[:len(t0)], np.repeat(resolved0, BACKBONE_ATOMS))
+
+
+def test_convert_validates_frame_shape(tmp_path):
+    data = _fake_scn(n_train=1)
+    data['train']['crd'][0] = data['train']['crd'][0][:-1]  # corrupt
+    with pytest.raises(ValueError, match='all-atom frame'):
+        convert_sidechainnet(data, str(tmp_path / 'bad.npz'))
+
+
+def test_unknown_letters_map_to_unk():
+    assert tokenize_sequence('XZB').tolist() == [UNK_ID] * 3
+
+
+def test_batches_apply_resolution_mask(tmp_path):
+    data = _fake_scn()
+    path = convert_sidechainnet(data, str(tmp_path / 'scn.npz'))
+    ds = PointCloudDataset.load(path)
+    got = False
+    for b in ds.batches(batch_size=2, buckets=(64,)):
+        assert b['mask'].dtype == bool
+        # any unresolved residue must be masked out in the batch
+        got = True
+        break
+    assert got
+
+
+def test_training_loss_decreases_on_converted_data(tmp_path):
+    """The VERDICT gate: loss decreases on real-format (converted) data,
+    end to end through denoise.py --dataset."""
+    import sys
+    data = _fake_scn(n_train=16, lmin=12, lmax=17, seed=3)
+    path = convert_sidechainnet(data, str(tmp_path / 'scn.npz'))
+
+    import denoise as denoise_cli
+    argv = sys.argv
+    sys.argv = ['denoise.py', '--steps', '12', '--nodes', '64',
+                '--degrees', '2', '--accum', '1', '--dataset', path]
+    try:
+        history = denoise_cli.main()
+    finally:
+        sys.argv = argv
+    losses = [h['loss'] for h in history]
+    assert all(np.isfinite(l) for l in losses)
+    # decreasing trend: last-3 average well below first-3 average
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses

@@ -1,0 +1,182 @@
+"""Pallas TPU kernel: fused multi-degree SE(3) attention.
+
+The reference computes attention per degree with separate einsums
+(/root/reference/se3_transformer_pytorch/se3_transformer_pytorch.py:508-516):
+logits summed jointly over (channel, m), softmax, then a weighted sum per
+degree — with the [b, h, n, J] similarity/attention tensors round-tripping
+memory between those steps (SURVEY.md §3.4 hot loop, §7.2 step 7b).
+
+TPU-native formulation: attention stays PER DEGREE (each degree has its
+own softmax, as in the reference), but within a degree the (dim_head, m)
+axes are flattened into one feature axis D = dim_head * (2d+1) — the
+logits reduce over both jointly — and one kernel fuses the whole
+sim/softmax/weighted-sum chain over the kv slots in VMEM:
+
+    per (b*h, n-block) program:
+        sim[e, j] = scale * sum_D q[e, D] k[e, j, D]     (VPU reduce)
+        attn      = softmax_j(sim + mask)                 (VMEM)
+        out[e, D] = sum_j attn[e, j] v[e, j, D]           (VPU reduce)
+
+so sim/attn never exist in HBM and k/v are read exactly once. J (self +
+null + neighbors) is small (~K+2 <= 64), so the whole slot axis fits in
+VMEM and no online-softmax machinery is needed — this is the
+graph-attention analogue of a single flash-attention tile. The caller
+(ops.attention.AttentionSE3) invokes it once per degree; degrees share
+nothing but the mask, so per-degree calls lose no fusion opportunity.
+
+Multi-query attention (kv_heads < heads) is handled in the index maps:
+query-head programs map onto their shared kv head, so the 1-head k/v is
+never materialized per query head.
+
+Backward: the op is wrapped in jax.custom_vjp with the XLA reference
+implementation's VJP (attention backward is matmul-shaped and XLA-fuses
+well; the forward fusion is where the HBM win is). Numerics are gated
+against the XLA path in tests (interpreter mode) and on-chip
+(scripts/tpu_checks.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def attention_reference(q, k, v, mask, scale):
+    """XLA reference: q [BH, n, D], k/v [BKV, n, J, D], mask [B, n, J] or
+    None -> out [BH, n, D]. BH = B*h, BKV = B*kv_h; kv heads are shared
+    by contiguous groups of query heads."""
+    BH = q.shape[0]
+    BKV = k.shape[0]
+    group = BH // BKV  # query heads per kv head
+    kq = jnp.repeat(k, group, axis=0)
+    vq = jnp.repeat(v, group, axis=0)
+    sim = jnp.einsum('bnd,bnjd->bnj', q, kq) * scale
+    if mask is not None:
+        h = BH // mask.shape[0]
+        mq = jnp.repeat(mask, h, axis=0)
+        sim = jnp.where(mq, sim, NEG_INF)
+    attn = jax.nn.softmax(sim, axis=-1)
+    return jnp.einsum('bnj,bnjd->bnd', attn, vq)
+
+
+def _softmax_weighted_sum(q, k, v, sim, o_ref):
+    m = jnp.max(sim, axis=-1, keepdims=True)
+    p = jnp.exp(sim - m)
+    attn = p / jnp.sum(p, axis=-1, keepdims=True)
+    o_ref[0] = jnp.sum(attn[:, :, None] * v, axis=1).astype(o_ref.dtype)
+
+
+def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale):
+    q = q_ref[0]            # [n_b, D]
+    k = k_ref[0]            # [n_b, J, D]
+    v = v_ref[0]            # [n_b, J, D]
+    sim = jnp.sum(k * q[:, None, :], axis=-1) * scale      # [n_b, J]
+    sim = jnp.where(mask_ref[0], sim, NEG_INF)
+    _softmax_weighted_sum(q, k, v, sim, o_ref)
+
+
+def _kernel_nomask(q_ref, k_ref, v_ref, o_ref, *, scale):
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    sim = jnp.sum(k * q[:, None, :], axis=-1) * scale
+    _softmax_weighted_sum(q, k, v, sim, o_ref)
+
+
+def _pick_block_n(n: int, J: int, D: int,
+                  vmem_budget: int = 10 * 2 ** 20) -> int:
+    for block_n in (512, 256, 128, 64, 32, 16, 8):
+        # k, v [n_b, J, D] dominate; q/out [n_b, D]; sim-class [n_b, J]
+        total = block_n * (2 * J * D + 2 * D + 4 * J) * 4
+        if total <= vmem_budget:
+            # never exceed n rounded up to the 8-row sublane minimum
+            # (a tiny input must not pad to a full 512-row block)
+            return min(block_n, max(8, _round_up(n, 8)))
+    return 8
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@functools.partial(jax.jit, static_argnames=('heads', 'scale', 'interpret'))
+def _fused_attention_fwd_impl(q, k, v, mask, heads: int, scale: float,
+                              interpret: bool = False):
+    BH, n, D = q.shape
+    BKV, _, J, _ = k.shape
+    group = BH // BKV
+
+    block_n = _pick_block_n(n, J, D)
+    np_ = _round_up(n, block_n)
+    if np_ != n:
+        q = jnp.pad(q, ((0, 0), (0, np_ - n), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, np_ - n), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, np_ - n), (0, 0), (0, 0)))
+        if mask is not None:
+            # padded rows: keep slots valid so their softmax stays finite
+            mask = jnp.pad(mask, ((0, 0), (0, np_ - n), (0, 0)),
+                           constant_values=True)
+
+    in_specs = [
+        pl.BlockSpec((1, block_n, D), lambda bh, e: (bh, e, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_n, J, D),
+                     lambda bh, e: (bh // group, e, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_n, J, D),
+                     lambda bh, e: (bh // group, e, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [q, k, v]
+    if mask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, block_n, J), lambda bh, e: (bh // heads, e, 0),
+                         memory_space=pltpu.VMEM))
+        args.append(mask)
+        kernel = functools.partial(_kernel, scale=scale)
+    else:
+        # no mask input at all: the constant-True mask would only waste a
+        # [1, block_n, J] DMA per program
+        kernel = functools.partial(_kernel_nomask, scale=scale)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(BH, np_ // block_n),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_n, D), lambda bh, e: (bh, e, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, np_, D), jnp.float32),
+        interpret=interpret,
+    )(*args)
+    return out[:, :n]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def fused_attention(q, k, v, mask, heads: int, scale: float,
+                    interpret: bool = False):
+    """Fused multi-degree attention. q [B*h, n, D], k/v [B*kv_h, n, J, D],
+    mask [B, n, J] bool or None -> [B*h, n, D] float32."""
+    return _fused_attention_fwd_impl(q, k, v, mask, heads, scale, interpret)
+
+
+def _fa_fwd(q, k, v, mask, heads, scale, interpret):
+    out = _fused_attention_fwd_impl(q, k, v, mask, heads, scale, interpret)
+    return out, (q, k, v, mask)
+
+
+def _fa_bwd(heads, scale, interpret, res, g):
+    q, k, v, mask = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(q_, k_, v_, mask, scale),
+        q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+fused_attention.defvjp(_fa_fwd, _fa_bwd)

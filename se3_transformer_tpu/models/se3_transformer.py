@@ -110,6 +110,10 @@ class SE3TransformerModule(nn.Module):
     out_fiber_dict: Optional[Dict[int, int]] = None
     # None -> auto (Pallas fused pairwise kernel on TPU, XLA elsewhere)
     pallas: Optional[bool] = None
+    # None -> auto: fused per-degree attention kernel on TPU (sim/softmax/
+    # weighted-sum in VMEM, one kv pass — kernels.pallas_attention)
+    pallas_attention: Optional[bool] = None
+    pallas_attention_interpret: bool = False  # tests: interpreter-mode kernel
     # matmul precision policy: None = backend default (bf16 MXU on TPU,
     # fastest), 'float32'/'highest' = strict (equivariance < 1e-4 on TPU;
     # see scripts/tpu_checks.py). The basis itself is always full precision.
@@ -482,6 +486,8 @@ class SE3TransformerModule(nn.Module):
             one_headed_key_values=self.one_headed_key_values,
             norm_gated_scale=self.norm_gated_scale,
             reversible=self.reversible, pallas=self.pallas,
+            pallas_attention=self.pallas_attention,
+            pallas_attention_interpret=self.pallas_attention_interpret,
             shared_radial_hidden=self.shared_radial_hidden,
             edge_chunks=self.edge_chunks, name='trunk')(
                 x, edge_info, rel_dist, basis, global_feats, pos_emb, mask)

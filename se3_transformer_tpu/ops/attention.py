@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Dict, Optional, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ..utils.helpers import batched_index_select, to_order
@@ -41,6 +42,10 @@ class AttentionSE3(nn.Module):
     linear_proj_keys: bool = False
     tie_key_values: bool = False
     pallas: Optional[bool] = None
+    # fused attention kernel (kernels.pallas_attention): per-degree fused
+    # sim/softmax/weighted-sum in VMEM, one kv pass. None = auto (TPU)
+    pallas_attention: Optional[bool] = None
+    pallas_attention_interpret: bool = False
     shared_radial_hidden: bool = False
     edge_chunks: Optional[int] = None
 
@@ -148,24 +153,40 @@ class AttentionSE3(nn.Module):
                 v = jnp.concatenate((g_v, v), axis=3)
 
             scale = self.dim_head ** -0.5
-            if one_headed:
-                sim = jnp.einsum('bhidm,bijdm->bhij', q, k[:, 0]) * scale
-            else:
-                sim = jnp.einsum('bhidm,bhijdm->bhij', q, k) * scale
+            J = k.shape[3]
 
+            padded_mask = None
             if neighbor_mask is not None:
-                num_left_pad = sim.shape[-1] - neighbor_mask.shape[-1]
-                padded = jnp.pad(neighbor_mask,
-                                 ((0, 0), (0, 0), (num_left_pad, 0)),
-                                 constant_values=True)
-                sim = jnp.where(padded[:, None], sim,
-                                jnp.finfo(sim.dtype).min)
+                num_left_pad = J - neighbor_mask.shape[-1]
+                padded_mask = jnp.pad(neighbor_mask,
+                                      ((0, 0), (0, 0), (num_left_pad, 0)),
+                                      constant_values=True)
 
-            attn = nn.softmax(sim, axis=-1)
-            if one_headed:
-                out = jnp.einsum('bhij,bijdm->bhidm', attn, v[:, 0])
+            use_fused = self.pallas_attention if self.pallas_attention \
+                is not None else jax.default_backend() == 'tpu'
+            if use_fused or self.pallas_attention_interpret:
+                from ..kernels.pallas_attention import fused_attention
+                # flatten (dim_head, m) into one joint feature axis (the
+                # logits reduce over both) and fold heads into batch
+                q2 = q.reshape(b * h, n, self.dim_head * m)
+                k2, v2 = [t.reshape(b * kv_h, n, J, self.dim_head * m)
+                          for t in (k, v)]
+                out = fused_attention(q2, k2, v2, padded_mask, h, scale,
+                                      self.pallas_attention_interpret)
+                out = out.reshape(b, h, n, self.dim_head, m)
             else:
-                out = jnp.einsum('bhij,bhijdm->bhidm', attn, v)
+                if one_headed:
+                    sim = jnp.einsum('bhidm,bijdm->bhij', q, k[:, 0]) * scale
+                else:
+                    sim = jnp.einsum('bhidm,bhijdm->bhij', q, k) * scale
+                if padded_mask is not None:
+                    sim = jnp.where(padded_mask[:, None], sim,
+                                    jnp.finfo(sim.dtype).min)
+                attn = nn.softmax(sim, axis=-1)
+                if one_headed:
+                    out = jnp.einsum('bhij,bijdm->bhidm', attn, v[:, 0])
+                else:
+                    out = jnp.einsum('bhij,bhijdm->bhidm', attn, v)
             outputs[degree] = out.transpose(0, 2, 1, 3, 4).reshape(
                 b, n, h * self.dim_head, m)
 
@@ -197,6 +218,8 @@ class AttentionBlockSE3(nn.Module):
     one_headed_key_values: bool = False
     norm_gated_scale: bool = False
     pallas: Optional[bool] = None
+    pallas_attention: Optional[bool] = None
+    pallas_attention_interpret: bool = False
     shared_radial_hidden: bool = False
     edge_chunks: Optional[int] = None
 
@@ -219,6 +242,8 @@ class AttentionBlockSE3(nn.Module):
             linear_proj_keys=self.linear_proj_keys,
             tie_key_values=self.tie_key_values,
             pallas=self.pallas,
+            pallas_attention=self.pallas_attention,
+            pallas_attention_interpret=self.pallas_attention_interpret,
             shared_radial_hidden=self.shared_radial_hidden,
             edge_chunks=self.edge_chunks,
             name='attn')(out, edge_info, rel_dist, basis, global_feats,

@@ -48,6 +48,8 @@ class SequentialTrunk(nn.Module):
     norm_gated_scale: bool = False
     reversible: bool = False
     pallas: Optional[bool] = None
+    pallas_attention: Optional[bool] = None
+    pallas_attention_interpret: bool = False
     shared_radial_hidden: bool = False
     edge_chunks: Optional[int] = None
 
@@ -72,6 +74,8 @@ class SequentialTrunk(nn.Module):
                 one_headed_key_values=self.one_headed_key_values,
                 norm_gated_scale=self.norm_gated_scale,
                 pallas=self.pallas,
+                pallas_attention=self.pallas_attention,
+                pallas_attention_interpret=self.pallas_attention_interpret,
                 shared_radial_hidden=self.shared_radial_hidden,
                 edge_chunks=self.edge_chunks,
                 name=f'attn_block{i}')(

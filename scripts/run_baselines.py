@@ -102,7 +102,7 @@ def run_config(name, module, n, steps, rng):
                 compile_s=round(compile_s, 1))
 
 
-def main():
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument('--steps', type=int, default=8)
     ap.add_argument('--configs', nargs='+', default=None)
@@ -111,7 +111,7 @@ def main():
     ap.add_argument('--cpu', action='store_true',
                     help='force CPU (the axon TPU tunnel is single-client; '
                          'use this when another process holds the chip)')
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     import jax
     if args.cpu:

@@ -59,6 +59,39 @@ def main():
         failed = True
         log('bench FAILED:\n' + traceback.format_exc())
 
+    log('--- baseline configs ---')
+    try:
+        import run_baselines
+        out_path = os.path.join(os.path.dirname(here), 'BASELINES_TPU.json')
+        run_baselines.main(['--steps', '5', '--out', out_path])
+        log(f'run_baselines: completed ({out_path})')
+    except Exception:
+        failed = True
+        log('run_baselines FAILED:\n' + traceback.format_exc())
+
+    log('--- flagship profile ---')
+    try:
+        import numpy as np
+        import jax.numpy as jnp
+        from se3_transformer_tpu.training.recipes import flagship
+        module = flagship()
+        rng = np.random.RandomState(0)
+        feats = jnp.asarray(rng.normal(size=(1, 1024, 64)), jnp.float32)
+        coors = jnp.asarray(rng.normal(size=(1, 1024, 3)) * 3, jnp.float32)
+        mask = jnp.ones((1, 1024), bool)
+        params = jax.jit(module.init, static_argnames=('return_type',))(
+            jax.random.PRNGKey(0), feats, coors, mask=mask,
+            return_type=1)['params']
+        fwd = jax.jit(lambda p, c: module.apply(
+            {'params': p}, feats, c, mask=mask, return_type=1))
+        jax.block_until_ready(fwd(params, coors))  # compile
+        from se3_transformer_tpu.utils.observability import profile_trace
+        with profile_trace('/tmp/flagship_trace'):
+            jax.block_until_ready(fwd(params, coors))
+        log('profile: /tmp/flagship_trace written')
+    except Exception:
+        log('profile FAILED (non-fatal):\n' + traceback.format_exc())
+
     log(f'session done ({"FAILED" if failed else "ok"}), releasing chip')
     return 2 if failed else 0
 

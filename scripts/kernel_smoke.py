@@ -1,0 +1,85 @@
+"""Fast on-chip smoke test of the Pallas kernels (Mosaic lowering + numerics).
+
+Small shapes so compiles are quick; the full validation lives in
+scripts/tpu_checks.py. Exits nonzero on any failure.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check(name, a, b, tol=1e-4):
+    scale = float(jnp.abs(b).max()) + 1e-9
+    rel = float(jnp.abs(a - b).max()) / scale
+    ok = rel < tol
+    print(f'{name}: rel={rel:.2e} [{"PASS" if ok else "FAIL"}]')
+    return ok
+
+
+def main():
+    print('backend:', jax.default_backend())
+    rng = np.random.RandomState(0)
+    ok = True
+
+    # --- pairwise conv kernel, a few shape classes ---
+    from se3_transformer_tpu.kernels.pallas_pairwise import (
+        fused_pairwise_conv, fused_pairwise_conv_bwd,
+    )
+    for (E, mid, IF, O, P) in [(300, 129, 24, 8, 5), (64, 33, 280, 20, 7),
+                               (1000, 129, 56, 8, 7)]:
+        h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
+        w3 = jnp.asarray(rng.normal(size=(mid, IF, O)), jnp.float32)
+        v2 = jnp.asarray(rng.normal(size=(E, P, IF)), jnp.float32)
+        g = jnp.asarray(rng.normal(size=(E, P, O)), jnp.float32)
+
+        with jax.default_matmul_precision('highest'):
+            ref = jnp.einsum('epk,eko->epo', v2,
+                             jnp.einsum('em,mko->eko', h, w3))
+        out = fused_pairwise_conv(h, w3, v2)
+        ok &= check(f'pairwise fwd E={E} IF={IF} O={O} P={P}', out, ref)
+
+        def f(h, w3, v2):
+            r = jnp.einsum('em,mko->eko', h, w3)
+            return (jnp.einsum('epk,eko->epo', v2, r) * g).sum()
+
+        with jax.default_matmul_precision('highest'):
+            dh_r, dw3_r, dv2_r = jax.grad(f, argnums=(0, 1, 2))(h, w3, v2)
+        dh, dw3, dv2 = fused_pairwise_conv_bwd(h, w3, v2, g)
+        ok &= check(f'pairwise bwd dh  E={E}', dh, dh_r)
+        ok &= check(f'pairwise bwd dw3 E={E}', dw3, dw3_r)
+        ok &= check(f'pairwise bwd dv2 E={E}', dv2, dv2_r)
+
+    # --- attention kernel ---
+    from se3_transformer_tpu.kernels.pallas_attention import (
+        attention_reference, fused_attention,
+    )
+    for (BH, BKV, n, J, D, masked) in [(8, 8, 100, 17, 24, True),
+                                       (8, 1, 64, 33, 56, True),
+                                       (4, 4, 128, 9, 8, False)]:
+        q = jnp.asarray(rng.normal(size=(BH, n, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(BKV, n, J, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(BKV, n, J, D)), jnp.float32)
+        B = 1
+        heads = BH // B
+        mask = None
+        if masked:
+            mask = jnp.asarray(rng.rand(B, n, J) > 0.2)
+            mask = mask.at[:, :, 0].set(True)
+        scale = D ** -0.5
+        with jax.default_matmul_precision('highest'):
+            ref = attention_reference(q, k, v, mask, scale)
+        out = fused_attention(q, k, v, mask, heads, scale)
+        ok &= check(f'attention BH={BH} BKV={BKV} J={J} D={D} '
+                    f'mask={masked}', out, ref)
+
+    print('ALL PASS' if ok else 'FAILURES')
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -4,29 +4,40 @@ This is THE compute hot spot of the model (SURVEY.md §3.3): per edge e and
 degree pair (d_in, d_out), the reference computes a radial profile
 R[e, o, i, f] with a per-pair MLP, multiplies by the angular basis
 B[e, P, Q, f] (P = 2*d_out+1, Q = 2*d_in+1) and contracts with gathered
-neighbor features x[e, i, Q]. The XLA path materializes R in HBM —
-2*E*o*i*f floats of traffic that dwarf the FLOPs (bandwidth-bound ~6x).
+neighbor features x[e, i, Q] (reference se3_transformer_pytorch.py:336-338).
+The XLA path materializes R in HBM — 2*E*IF*O floats of traffic that dwarf
+the FLOPs (bandwidth-bound ~6x). This kernel fuses the final radial matmul
+with the contraction so R only ever exists as VMEM tiles.
 
-This kernel fuses the final radial matmul with the contraction so R only
-ever exists as VMEM tiles:
+Mosaic-lowering ground rules (learned on-chip: `infer-vector-layout:
+unsupported shape cast` / `lhs contracting dims must be of size 1`):
+every in-kernel tensor op must be a 2D matmul with single contracting
+dims, a static sublane (row) slice, a [1, E] x [O, E] sublane broadcast,
+or a sublane reduction. All reshapes/transposes happen OUTSIDE the kernel
+in XLA, where they are free relayouts. The layout that makes that
+possible puts the EDGE axis on lanes:
 
-    inputs  H  [E, mid+1]      radial-MLP hidden (with folded-bias 1s col)
-            W3 [mid+1, IF, O]  final radial weight, (i, f) flattened
-            V2 [E, P, IF]      = sum_Q B[e,P,Q,f] x[e,i,Q]  (cheap, XLA)
-    per (if-chunk, e-block) program:
-            R   = H_blk @ W3_chunk            # MXU, shared weights
-            out += V2_chunk  @b R             # MXU, per-edge batched
-    output  out [E, P, O]
+    hT  [mid, E]        radial-MLP hidden, transposed (bias folded: ones row)
+    w3T [IF*O, mid]     final radial weight, (if, o) flattened if-major
+    v2T [P, IF, E]      = sum_Q B[e,P,Q,f] x[e,i,Q], edge-last
+    per (e-block, if-chunk) program:
+        rT   = w3T_chunk @ hT_blk            # one 2D MXU matmul, R in VMEM
+        out[pO+o, e] += v2T[p, i, e] * rT[iO+o, e]   # P*bif sublane FMAs
+    outT [P*O, E] -> transpose/reshape outside -> out [E, P, O]
 
-Grid order is (n_if, n_e) with the output block revisited across the outer
-if-axis (accumulate), so W3 streams through VMEM once per if-chunk and the
-huge R tensor never touches HBM. The P axis rides the sublane dimension
-(P <= 7 pads to 8 — cheap), O rides lanes.
+The grid is (n_e, n_if) with the out block revisited across the inner
+if-axis (consecutive revisits — the legal TPU accumulation pattern), so
+the huge R tensor never touches HBM and w3 streams through VMEM.
+
+The backward runs as TWO kernels because its two accumulated cotangents
+want different inner grid axes: dW3 accumulates over edges (grid
+(n_if, n_e), e inner) while dH accumulates over if-chunks (grid
+(n_e, n_if), f inner). dV2 falls out of kernel A for free. dR exists only
+as per-(i) VMEM blocks in both.
 """
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,53 +45,77 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _kernel(h_ref, w3_ref, v2_ref, o_ref):
-    # R chunk: [E_b, IF_b, O] — exists only in VMEM
-    r = jax.lax.dot_general(
-        h_ref[:], w3_ref[:],
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    # per-edge batched contraction: [E_b, P, IF_b] x [E_b, IF_b, O].
-    # Each (f, e) program owns its own output block (partial sums over the
-    # if-axis are reduced outside the kernel): output blocks are never
-    # revisited, which keeps the TPU revisit rules trivially satisfied and
-    # W3 streaming to exactly one pass.
-    o_ref[0] = jax.lax.dot_general(
-        v2_ref[:], r,
-        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32).astype(o_ref.dtype)
-
-
 def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _pick_blocks(E: int, IF: int, O: int, mid: int,
-                 vmem_budget: int = 10 * 2 ** 20,
-                 bwd: bool = False):
-    """Choose (block_e, block_if) so the kernel working set fits in VMEM.
+def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
+                 vmem_budget: int = 6 * 2 ** 20,
+                 max_unroll: int = 256):
+    """Choose (block_e, block_if) so the working set fits in VMEM (with
+    headroom for double buffering) and the in-kernel unrolled loop count
+    P*block_if stays bounded (Mosaic compile time).
 
-    The backward kernel's working set is roughly double the forward's
-    (extra dR chunk, g input block, and dW3/dV2/dH output blocks), so it
-    gets its own accounting."""
-    block_if = min(IF, 128)
-    while True:
-        for block_e in (256, 128, 64, 32, 16, 8):
-            w3 = mid * block_if * O * 4
-            r = block_e * block_if * O * 4
-            v2 = block_e * 8 * block_if * 4
-            out = block_e * 8 * O * 4
-            h = block_e * mid * 4
-            total = w3 + 2 * r + v2 + out + h
-            if bwd:
-                # + dR chunk, g block, dW3 (w3-sized), dV2 (v2-sized),
-                # dH (h-sized) blocks
-                total += r + out + w3 + v2 + h
+    Mosaic block-shape rule: every blocked dim must either cover the full
+    array or be divisible by its tile quantum — so block_if is the full IF
+    (n_if == 1) or a multiple of 8, and block_e a multiple of 128."""
+    e_cap = _round_up(E, 128)
+    for block_e in (512, 256, 128):
+        if block_e > e_cap:
+            continue
+        block_if = min(IF, max(1, max_unroll // max(P, 1)))
+        if block_if < IF:
+            block_if = max(8, block_if // 8 * 8)
+        while True:
+            ht = mid * block_e
+            w3 = block_if * O * mid
+            rt = block_if * O * block_e
+            v2 = P * block_if * block_e
+            out = P * O * block_e
+            total = 4 * (ht + w3 + 2 * rt + v2 + out)
             if total <= vmem_budget:
                 return block_e, block_if
-        if block_if <= 8:
-            return 8, block_if
-        block_if //= 2
+            if block_if <= 8:
+                break
+            block_if = max(8, block_if // 2 // 8 * 8)
+    return 128, min(IF, 8)
+
+
+def _fwd_kernel(ht_ref, w3t_ref, v2t_ref, o_ref, *, P, O, bif):
+    f = pl.program_id(1)
+    # R chunk, transposed: [bif*O, E_b] — exists only in VMEM
+    rt = jax.lax.dot_general(
+        w3t_ref[:], ht_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+    for p in range(P):
+        acc = None
+        for i in range(bif):
+            vrow = v2t_ref[p, i:i + 1, :]            # [1, E_b]
+            term = vrow * rt[i * O:(i + 1) * O, :]   # [O, E_b]
+            acc = term if acc is None else acc + term
+        sl = slice(p * O, (p + 1) * O)
+
+        @pl.when(f == 0)
+        def _(acc=acc, sl=sl):
+            o_ref[sl, :] = acc.astype(o_ref.dtype)
+
+        @pl.when(f > 0)
+        def _(acc=acc, sl=sl):
+            o_ref[sl, :] = o_ref[sl, :] + acc.astype(o_ref.dtype)
+
+
+def _to_lanes(h, w3, v2, g=None):
+    """XLA-side relayouts (free) into the edge-on-lanes kernel layouts."""
+    E, mid = h.shape
+    _, IF, O = w3.shape
+    P = v2.shape[1]
+    ht = h.T                                        # [mid, E]
+    w3t = w3.reshape(mid, IF * O).T                 # [(if,o), mid]
+    v2t = v2.transpose(1, 2, 0)                     # [P, IF, E]
+    gt = None if g is None else g.transpose(1, 2, 0).reshape(P * O, E)
+    return ht, w3t, v2t, gt
 
 
 @functools.partial(jax.jit, static_argnames=('interpret',))
@@ -95,40 +130,37 @@ def fused_pairwise_conv(h: jnp.ndarray, w3: jnp.ndarray, v2: jnp.ndarray,
     _, IF, O = w3.shape
     P = v2.shape[1]
 
-    block_e, block_if = _pick_blocks(E, IF, O, mid)
+    block_e, block_if = _pick_blocks(E, IF, O, P, mid)
+    Ep, IFp = _round_up(E, block_e), _round_up(IF, block_if)
 
-    Ep = _round_up(E, block_e)
-    IFp = _round_up(IF, block_if)
+    ht, w3t, v2t, _ = _to_lanes(h, w3, v2)
     if Ep != E:
-        h = jnp.pad(h, ((0, Ep - E), (0, 0)))
-        v2 = jnp.pad(v2, ((0, Ep - E), (0, 0), (0, 0)))
+        ht = jnp.pad(ht, ((0, 0), (0, Ep - E)))
+        v2t = jnp.pad(v2t, ((0, 0), (0, 0), (0, Ep - E)))
     if IFp != IF:
-        w3 = jnp.pad(w3, ((0, 0), (0, IFp - IF), (0, 0)))
-        v2 = jnp.pad(v2, ((0, 0), (0, 0), (0, IFp - IF)))
+        w3t = jnp.pad(w3t, ((0, (IFp - IF) * O), (0, 0)))
+        v2t = jnp.pad(v2t, ((0, 0), (0, IFp - IF), (0, 0)))
 
-    n_if = IFp // block_if
-    n_e = Ep // block_e
+    n_e, n_if = Ep // block_e, IFp // block_if
 
-    out = pl.pallas_call(
-        _kernel,
-        grid=(n_if, n_e),
+    outt = pl.pallas_call(
+        functools.partial(_fwd_kernel, P=P, O=O, bif=block_if),
+        grid=(n_e, n_if),
         in_specs=[
-            pl.BlockSpec((block_e, mid), lambda f, e: (e, 0),
+            pl.BlockSpec((mid, block_e), lambda e, f: (0, e),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((mid, block_if, O), lambda f, e: (0, f, 0),
+            pl.BlockSpec((block_if * O, mid), lambda e, f: (f, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_e, P, block_if), lambda f, e: (e, 0, f),
+            pl.BlockSpec((P, block_if, block_e), lambda e, f: (0, f, e),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_e, P, O), lambda f, e: (f, e, 0, 0),
+        out_specs=pl.BlockSpec((P * O, block_e), lambda e, f: (0, e),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n_if, Ep, P, O), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((P * O, Ep), jnp.float32),
         interpret=interpret,
-    )(h, w3, v2)
+    )(ht, w3t, v2t)
 
-    # reduce the per-if-chunk partial sums (n_if <= 7 for IF <= 896; XLA
-    # fuses this into a cheap elementwise pass)
-    return out.sum(axis=0)[:E]
+    return outt.reshape(P, O, Ep).transpose(2, 0, 1)[:E]
 
 
 def pallas_available() -> bool:
@@ -141,50 +173,77 @@ def pallas_available() -> bool:
 # Cotangents of out[e,P,o] = sum_{if} V2[e,P,if] (H W3)[e,if,o]:
 #   dV2[e,P,if] = sum_o  g[e,P,o]  R[e,if,o]
 #   dR [e,if,o] = sum_P  V2[e,P,if] g[e,P,o]
-#   dH [e,m]    = sum_{if,o} dR[e,if,o] W3[m,if,o]     (shared matmul)
-#   dW3[m,if,o] = sum_e  H[e,m] dR[e,if,o]             (shared matmul)
-# R and dR exist only as VMEM chunks. Accumulations that would revisit
-# output blocks non-consecutively (dH over the outer if-axis) are written
-# as per-chunk partials and reduced outside; dW3 accumulates over the
-# minormost (e) axis, which is the legal consecutive-revisit pattern.
+#   dH [e,m]    = sum_{if,o} dR[e,if,o] W3[m,if,o]
+#   dW3[m,if,o] = sum_e  H[e,m] dR[e,if,o]
+# Kernel A (grid (n_if, n_e), e inner): rT matmul -> dV2 rows (sublane
+# reduce), dR blocks -> dW3 accumulated over the inner edge axis.
+# Kernel B (grid (n_e, n_if), f inner): dR blocks (no matmul needed)
+# -> dH accumulated over the inner if axis.
 
 
-def _bwd_kernel(h_ref, w3_ref, v2_ref, g_ref,
-                dv2_ref, dh_ref, dw3_ref):
+def _bwd_a_kernel(ht_ref, h_ref, w3t_ref, v2t_ref, gt_ref,
+                  dv2_ref, dw3_ref, *, P, O, bif):
     e = pl.program_id(1)
+    rt = jax.lax.dot_general(
+        w3t_ref[:], ht_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)          # [bif*O, E_b]
+    g = gt_ref[:]                                    # [P*O, E_b]
+    for i in range(bif):
+        r_i = rt[i * O:(i + 1) * O, :]               # [O, E_b]
+        dr_i = None
+        for p in range(P):
+            gp = g[p * O:(p + 1) * O, :]             # [O, E_b]
+            # dV2[(p, i)] = sum_o g[p,o,:] * r[i,o,:]
+            dv2_ref[p, i:i + 1, :] = jnp.sum(
+                gp * r_i, axis=0, keepdims=True).astype(dv2_ref.dtype)
+            vrow = v2t_ref[p, i:i + 1, :]            # [1, E_b]
+            term = vrow * gp                         # [O, E_b]
+            dr_i = term if dr_i is None else dr_i + term
+        # dW3 rows for this i: [O, E_b] @ [E_b, mid], accumulated over the
+        # inner edge grid axis (consecutive revisits)
+        upd = jax.lax.dot_general(
+            dr_i, h_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)      # [O, mid]
+        sl = slice(i * O, (i + 1) * O)
 
-    # R chunk for dV2
-    r = jax.lax.dot_general(
-        h_ref[:], w3_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)              # [E_b, IF_b, O]
-    g = g_ref[:]                                         # [E_b, P, O]
-    dv2_ref[0] = jax.lax.dot_general(
-        g, r, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32).astype(dv2_ref.dtype)
+        @pl.when(e == 0)
+        def _(upd=upd, sl=sl):
+            dw3_ref[sl, :] = upd.astype(dw3_ref.dtype)
 
-    # dR chunk: per-edge [IF_b, P] @ [P, O]
-    dr = jax.lax.dot_general(
-        v2_ref[:], g, dimension_numbers=(((1,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32)              # [E_b, IF_b, O]
+        @pl.when(e > 0)
+        def _(upd=upd, sl=sl):
+            dw3_ref[sl, :] = dw3_ref[sl, :] + upd.astype(dw3_ref.dtype)
 
-    # dH partial for this if-chunk: [E_b, IF_b*O] @ [IF_b*O, mid]
-    dh_ref[0] = jax.lax.dot_general(
-        dr, w3_ref[:],
-        dimension_numbers=(((1, 2), (1, 2)), ((), ())),
-        preferred_element_type=jnp.float32).astype(dh_ref.dtype)
 
-    # dW3 chunk accumulated over the inner e-axis (consecutive revisits)
-    upd = jax.lax.dot_general(
-        h_ref[:], dr, dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)              # [mid, IF_b, O]
+def _bwd_b_kernel(w3f_ref, v2t_ref, gt_ref, dh_ref, *, P, O, bif):
+    f = pl.program_id(1)
+    g = gt_ref[:]                                    # [P*O, E_b]
+    w3f = w3f_ref[0]                                 # [mid, bif*O]
+    acc = None
+    for i in range(bif):
+        dr_i = None
+        for p in range(P):
+            term = v2t_ref[p, i:i + 1, :] * g[p * O:(p + 1) * O, :]
+            dr_i = term if dr_i is None else dr_i + term
+        # dH partial: [mid, O] @ [O, E_b]
+        upd = jax.lax.dot_general(
+            w3f[:, i * O:(i + 1) * O], dr_i,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)      # [mid, E_b]
+        acc = upd if acc is None else acc + upd
 
-    @pl.when(e == 0)
+    @pl.when(f == 0)
     def _():
-        dw3_ref[:] = upd.astype(dw3_ref.dtype)
+        dh_ref[:] = acc.astype(dh_ref.dtype)
 
-    @pl.when(e > 0)
+    @pl.when(f > 0)
     def _():
-        dw3_ref[:] = dw3_ref[:] + upd.astype(dw3_ref.dtype)
+        dh_ref[:] = dh_ref[:] + acc.astype(dh_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=('interpret',))
@@ -199,50 +258,75 @@ def fused_pairwise_conv_bwd(h: jnp.ndarray, w3: jnp.ndarray,
     _, IF, O = w3.shape
     P = v2.shape[1]
 
-    block_e, block_if = _pick_blocks(E, IF, O, mid, bwd=True)
-    Ep = _round_up(E, block_e)
-    IFp = _round_up(IF, block_if)
+    block_e, block_if = _pick_blocks(E, IF, O, P, mid)
+    Ep, IFp = _round_up(E, block_e), _round_up(IF, block_if)
+
+    ht, w3t, v2t, gt = _to_lanes(h, w3, v2, g)
+    h_p, w3f = h, w3.reshape(mid, IF * O)
     if Ep != E:
-        h = jnp.pad(h, ((0, Ep - E), (0, 0)))
-        v2 = jnp.pad(v2, ((0, Ep - E), (0, 0), (0, 0)))
-        g = jnp.pad(g, ((0, Ep - E), (0, 0), (0, 0)))
+        ht = jnp.pad(ht, ((0, 0), (0, Ep - E)))
+        h_p = jnp.pad(h_p, ((0, Ep - E), (0, 0)))
+        v2t = jnp.pad(v2t, ((0, 0), (0, 0), (0, Ep - E)))
+        gt = jnp.pad(gt, ((0, 0), (0, Ep - E)))
     if IFp != IF:
-        w3 = jnp.pad(w3, ((0, 0), (0, IFp - IF), (0, 0)))
-        v2 = jnp.pad(v2, ((0, 0), (0, 0), (0, IFp - IF)))
+        w3t = jnp.pad(w3t, ((0, (IFp - IF) * O), (0, 0)))
+        w3f = jnp.pad(w3f, ((0, 0), (0, (IFp - IF) * O)))
+        v2t = jnp.pad(v2t, ((0, 0), (0, IFp - IF), (0, 0)))
 
-    n_if = IFp // block_if
-    n_e = Ep // block_e
+    n_e, n_if = Ep // block_e, IFp // block_if
 
-    dv2, dh_partial, dw3 = pl.pallas_call(
-        _bwd_kernel,
+    # kernel A: dV2 + dW3 (accumulate over inner e axis)
+    dv2t, dw3t = pl.pallas_call(
+        functools.partial(_bwd_a_kernel, P=P, O=O, bif=block_if),
         grid=(n_if, n_e),
         in_specs=[
+            pl.BlockSpec((mid, block_e), lambda f, e: (0, e),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec((block_e, mid), lambda f, e: (e, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((mid, block_if, O), lambda f, e: (0, f, 0),
+            pl.BlockSpec((block_if * O, mid), lambda f, e: (f, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_e, P, block_if), lambda f, e: (e, 0, f),
+            pl.BlockSpec((P, block_if, block_e), lambda f, e: (0, f, e),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_e, P, O), lambda f, e: (e, 0, 0),
+            pl.BlockSpec((P * O, block_e), lambda f, e: (0, e),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_e, P, block_if),
-                         lambda f, e: (f, e, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_e, mid), lambda f, e: (f, e, 0),
+            pl.BlockSpec((P, block_if, block_e), lambda f, e: (0, f, e),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((mid, block_if, O), lambda f, e: (0, f, 0),
+            pl.BlockSpec((block_if * O, mid), lambda f, e: (f, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n_if, Ep, P, block_if), jnp.float32),
-            jax.ShapeDtypeStruct((n_if, Ep, mid), jnp.float32),
-            jax.ShapeDtypeStruct((mid, IFp, O), jnp.float32),
+            jax.ShapeDtypeStruct((P, IFp, Ep), jnp.float32),
+            jax.ShapeDtypeStruct((IFp * O, mid), jnp.float32),
         ],
         interpret=interpret,
-    )(h, w3, v2, g)
+    )(ht, h_p, w3t, v2t, gt)
 
-    # dv2 partial blocks [n_if, Ep, P, block_if] -> [Ep, P, IFp]
-    dv2 = dv2.transpose(1, 2, 0, 3).reshape(Ep, P, IFp)
-    dh = dh_partial.sum(axis=0)
-    return dh[:E], dw3[:, :IF], dv2[:E, :, :IF]
+    # kernel B: dH (accumulate over inner if axis; no matmul with w3T
+    # needed — dR comes straight from v2/g). The if-chunk axis of w3 rides
+    # a leading block-1 dim so the (mid, bif*O) tail covers its full array
+    # dims (Mosaic block-shape rule).
+    w3f3 = w3f.reshape(mid, n_if, block_if * O).transpose(1, 0, 2)
+    dht = pl.pallas_call(
+        functools.partial(_bwd_b_kernel, P=P, O=O, bif=block_if),
+        grid=(n_e, n_if),
+        in_specs=[
+            pl.BlockSpec((1, mid, block_if * O), lambda e, f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, block_if, block_e), lambda e, f: (0, f, e),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((P * O, block_e), lambda e, f: (0, e),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((mid, block_e), lambda e, f: (0, e),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((mid, Ep), jnp.float32),
+        interpret=interpret,
+    )(w3f3, v2t, gt)
+
+    dh = dht.T[:E]
+    dw3 = dw3t.reshape(IFp, O, mid).transpose(2, 0, 1)[:, :IF]
+    dv2 = dv2t.transpose(2, 0, 1)[:E, :, :IF]
+    return dh, dw3, dv2

@@ -51,7 +51,7 @@ def _round_up(x: int, m: int) -> int:
 
 def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
                  vmem_budget: int = 6 * 2 ** 20,
-                 max_unroll: int = 256):
+                 max_unroll: int = 256, bwd: bool = False):
     """Choose (block_e, block_if) so the working set fits in VMEM (with
     headroom for double buffering) and the in-kernel unrolled loop count
     P*block_if stays bounded (Mosaic compile time).
@@ -73,6 +73,11 @@ def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
             v2 = P * block_if * block_e
             out = P * O * block_e
             total = 4 * (ht + w3 + 2 * rt + v2 + out)
+            if bwd:
+                # kernel A additionally holds h_p (block_e*mid), the gT
+                # block (= out-sized), the dv2 block (= v2-sized) and the
+                # dw3 block (= w3-sized)
+                total += 4 * (block_e * mid + out + v2 + w3)
             if total <= vmem_budget:
                 return block_e, block_if
             if block_if <= 8:
@@ -258,7 +263,7 @@ def fused_pairwise_conv_bwd(h: jnp.ndarray, w3: jnp.ndarray,
     _, IF, O = w3.shape
     P = v2.shape[1]
 
-    block_e, block_if = _pick_blocks(E, IF, O, P, mid)
+    block_e, block_if = _pick_blocks(E, IF, O, P, mid, bwd=True)
     Ep, IFp = _round_up(E, block_e), _round_up(IF, block_if)
 
     ht, w3t, v2t, gt = _to_lanes(h, w3, v2, g)

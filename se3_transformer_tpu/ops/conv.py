@@ -128,11 +128,10 @@ class PairwiseConvSE3(nn.Module):
 
     @nn.compact
     def __call__(self, edge_feats: jnp.ndarray, basis_slice: jnp.ndarray,
-                 x: jnp.ndarray,
-                 hidden: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 x: jnp.ndarray) -> jnp.ndarray:
         """edge_feats [b,n,k,e]; basis_slice [b,n,k,P,Q,F]; x [b,n,k,c_in,Q]
-        -> [b,n,k,c_out,P]. `hidden` supplies a precomputed (shared) radial
-        trunk activation [b,n,k,mid] (see ConvSE3.shared_radial_hidden)."""
+        -> [b,n,k,c_out,P]. (With a shared radial trunk, ConvSE3 fuses all
+        pairs of an output degree itself and never calls this module.)"""
         F = to_order(min(self.degree_in, self.degree_out))
         P = to_order(self.degree_out)
         IF = self.nc_in * F
@@ -143,8 +142,7 @@ class PairwiseConvSE3(nn.Module):
                            name='radial')(edge_feats)
             return pairwise_conv_contract(R, basis_slice, x)
 
-        h = hidden if hidden is not None \
-            else radial_hidden(edge_feats, self.mid_dim)     # [b,n,k,mid]
+        h = radial_hidden(edge_feats, self.mid_dim)          # [b,n,k,mid]
 
         w3 = self.param(
             'w3',
@@ -158,49 +156,61 @@ class PairwiseConvSE3(nn.Module):
         v2 = jnp.einsum('...pqf,...cq->...pcf', basis_slice, x)
         v2 = v2.reshape(*v2.shape[:-2], IF)  # [..., P, c_in*F]
 
-        use_pallas = self.pallas
-        if use_pallas is None:
-            use_pallas = jax.default_backend() == 'tpu'
-
-        lead = h.shape[:-1]
-        if self.edge_chunks is not None:
-            # explicit edge_chunks takes precedence over the Pallas kernel
-            # (the kernel bounds VMEM, but at huge channel counts the HBM
-            # h/v2/out tensors themselves need node-axis streaming): the
-            # per-chunk R tensor is rematerialized in the backward, so peak
-            # memory is bounded by the chunk size in both passes
-            n = h.shape[1]
-            c = self.edge_chunks
-            assert n % c == 0, f'nodes {n} must divide into {c} edge_chunks'
-
-            def chunk_fn(args):
-                h_c, v2_c = args
-                R = jnp.einsum('...m,mko->...ko', h_c, w3) + b3
-                return jnp.einsum('...pk,...ko->...po', v2_c, R)
-
-            h_s = h.reshape(h.shape[0], c, n // c, *h.shape[2:])
-            v2_s = v2.reshape(v2.shape[0], c, n // c, *v2.shape[2:])
-            h_s, v2_s = jnp.swapaxes(h_s, 0, 1), jnp.swapaxes(v2_s, 0, 1)
-            out = jax.lax.map(jax.checkpoint(chunk_fn), (h_s, v2_s))
-            out = jnp.swapaxes(out, 0, 1).reshape(*lead, P, self.nc_out)
-        elif use_pallas or self.pallas_interpret:
-            E = 1
-            for s in lead:
-                E *= s
-            h2 = h.reshape(E, h.shape[-1])
-            v22 = v2.reshape(E, P, IF)
-            # fold bias: ones column on h, bias row on w3
-            h2 = jnp.concatenate(
-                [h2, jnp.ones((E, 1), h2.dtype)], axis=-1)
-            w3b = jnp.concatenate([w3, b3[None]], axis=0)
-            out = _pairwise_contract_pallas(h2, w3b, v22,
-                                            self.pallas_interpret)
-            out = out.reshape(*lead, P, self.nc_out)
-        else:
-            R = jnp.einsum('...m,mko->...ko', h, w3) + b3
-            out = jnp.einsum('...pk,...ko->...po', v2, R)
-
+        out = _radial_contract(h, w3, b3, v2, pallas=self.pallas,
+                               pallas_interpret=self.pallas_interpret,
+                               edge_chunks=self.edge_chunks)
         return jnp.swapaxes(out, -1, -2)  # [..., c_out, P]
+
+
+def _radial_contract(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
+                     v2: jnp.ndarray, *, pallas: Optional[bool],
+                     pallas_interpret: bool,
+                     edge_chunks: Optional[int]) -> jnp.ndarray:
+    """Dispatch the fused radial-matmul x basis contraction:
+    h [b,n,k,mid], w3 [mid,IF,O], b3 [IF,O], v2 [b,n,k,P,IF]
+    -> [b,n,k,P,O] via the Pallas kernel / XLA einsums / chunked-remat."""
+    P, IF = v2.shape[-2], v2.shape[-1]
+    O = w3.shape[-1]
+    lead = h.shape[:-1]
+
+    use_pallas = pallas
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == 'tpu'
+
+    if edge_chunks is not None:
+        # explicit edge_chunks takes precedence over the Pallas kernel
+        # (the kernel bounds VMEM, but at huge channel counts the HBM
+        # h/v2/out tensors themselves need node-axis streaming): the
+        # per-chunk R tensor is rematerialized in the backward, so peak
+        # memory is bounded by the chunk size in both passes
+        n = h.shape[1]
+        c = edge_chunks
+        assert n % c == 0, f'nodes {n} must divide into {c} edge_chunks'
+
+        def chunk_fn(args):
+            h_c, v2_c = args
+            R = jnp.einsum('...m,mko->...ko', h_c, w3) + b3
+            return jnp.einsum('...pk,...ko->...po', v2_c, R)
+
+        h_s = h.reshape(h.shape[0], c, n // c, *h.shape[2:])
+        v2_s = v2.reshape(v2.shape[0], c, n // c, *v2.shape[2:])
+        h_s, v2_s = jnp.swapaxes(h_s, 0, 1), jnp.swapaxes(v2_s, 0, 1)
+        out = jax.lax.map(jax.checkpoint(chunk_fn), (h_s, v2_s))
+        return jnp.swapaxes(out, 0, 1).reshape(*lead, P, O)
+    if use_pallas or pallas_interpret:
+        E = 1
+        for s in lead:
+            E *= s
+        h2 = h.reshape(E, h.shape[-1])
+        v22 = v2.reshape(E, P, IF)
+        # fold bias: ones column on h, bias row on w3
+        h2 = jnp.concatenate(
+            [h2, jnp.ones((E, 1), h2.dtype)], axis=-1)
+        w3b = jnp.concatenate([w3, b3[None]], axis=0)
+        out = _pairwise_contract_pallas(h2, w3b, v22, pallas_interpret)
+        return out.reshape(*lead, P, O)
+    R = jnp.einsum('...m,mko->...ko', h, w3) + b3
+    return jnp.einsum('...pk,...ko->...po', v2, R)
 
 
 def pairwise_conv_contract(R: jnp.ndarray, B: jnp.ndarray,
@@ -257,19 +267,50 @@ class ConvSE3(nn.Module):
 
         outputs = {}
         for degree_out, m_out in self.fiber_out:
-            acc = None
-            for degree_in, m_in in self.fiber_in:
-                y = PairwiseConvSE3(
-                    degree_in, m_in, degree_out, m_out,
+            if self.shared_radial_hidden:
+                # the shared trunk makes every (d_in -> d_out) pair differ
+                # only in (w3, b3, v2), all concatenable along the
+                # contracted IF axis: ONE fused contraction (one Pallas
+                # launch / one big MXU matmul) per output degree instead of
+                # one per degree pair
+                v2s, w3s, b3s = [], [], []
+                for degree_in, m_in in self.fiber_in:
+                    F = to_order(min(degree_in, degree_out))
+                    IF = m_in * F
+                    v2 = jnp.einsum('...pqf,...cq->...pcf',
+                                    basis[f'{degree_in},{degree_out}'],
+                                    gathered[str(degree_in)])
+                    v2s.append(v2.reshape(*v2.shape[:-2], IF))
+                    w3s.append(self.param(
+                        f'w3_{degree_in}_{degree_out}',
+                        nn.initializers.variance_scaling(
+                            1.0, 'fan_in', 'truncated_normal',
+                            in_axis=0, out_axis=(1, 2)),
+                        (hidden.shape[-1], IF, m_out), hidden.dtype))
+                    b3s.append(self.param(
+                        f'b3_{degree_in}_{degree_out}',
+                        nn.initializers.zeros, (IF, m_out), hidden.dtype))
+                acc = _radial_contract(
+                    hidden, jnp.concatenate(w3s, axis=1),
+                    jnp.concatenate(b3s, axis=0),
+                    jnp.concatenate(v2s, axis=-1),
                     pallas=self.pallas,
                     pallas_interpret=self.pallas_interpret,
-                    edge_chunks=self.edge_chunks,
-                    name=f'pair_{degree_in}_{degree_out}')(
-                        edge_features,
-                        basis[f'{degree_in},{degree_out}'],
-                        gathered[str(degree_in)],
-                        hidden=hidden)
-                acc = y if acc is None else acc + y
+                    edge_chunks=self.edge_chunks)
+                acc = jnp.swapaxes(acc, -1, -2)  # [..., c_out, P]
+            else:
+                acc = None
+                for degree_in, m_in in self.fiber_in:
+                    y = PairwiseConvSE3(
+                        degree_in, m_in, degree_out, m_out,
+                        pallas=self.pallas,
+                        pallas_interpret=self.pallas_interpret,
+                        edge_chunks=self.edge_chunks,
+                        name=f'pair_{degree_in}_{degree_out}')(
+                            edge_features,
+                            basis[f'{degree_in},{degree_out}'],
+                            gathered[str(degree_in)])
+                    acc = y if acc is None else acc + y
 
             if self.pool:
                 acc = masked_mean(acc, neighbor_masks, axis=2) \

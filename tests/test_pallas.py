@@ -242,3 +242,70 @@ def test_model_with_fused_attention_matches_einsum_path():
         o2 = fused.apply({'params': params}, feats, coors, mask=mask,
                          return_type=1)
         assert np.abs(np.asarray(o1) - np.asarray(o2)).max() < 2e-5, kwargs
+
+
+def test_shared_radial_group_path():
+    """ConvSE3(shared_radial_hidden=True) fuses all (d_in -> d_out) pairs
+    of an output degree into one contraction. Gate (a) the group math
+    against a per-pair loop over the same params and (b) the Pallas
+    interpreter path against the XLA path."""
+    from se3_transformer_tpu.basis import get_basis
+    from se3_transformer_tpu.ops import ConvSE3, Fiber
+    from se3_transformer_tpu.ops.conv import radial_hidden
+    from se3_transformer_tpu.utils import batched_index_select
+    import flax.linen as nn
+
+    rng = np.random.RandomState(7)
+    n, k, dim, degrees = 24, 6, 6, 3
+    fiber = Fiber.create(degrees, dim)
+    feats = {str(d): jnp.asarray(rng.normal(size=(1, n, dim, 2 * d + 1)),
+                                 jnp.float32) for d in range(degrees)}
+    coors = jnp.asarray(rng.normal(size=(1, n, 3)) * 2, jnp.float32)
+    idx = jnp.asarray(rng.randint(0, n, (1, n, k)), jnp.int32)
+    mask = jnp.ones((1, n, k), bool)
+    coors_j = batched_index_select(coors, idx, axis=1)
+    rel = coors[:, :, None, :] - coors_j
+    rd = jnp.linalg.norm(rel, axis=-1)
+    basis = get_basis(rel, degrees - 1)
+    args = (feats, (idx, mask, None), rd, basis)
+
+    conv = ConvSE3(fiber, fiber, shared_radial_hidden=True, pallas=False,
+                   pool=False, self_interaction=False)
+    params = conv.init(jax.random.PRNGKey(0), *args)
+    out = conv.apply(params, *args)
+
+    conv_i = ConvSE3(fiber, fiber, shared_radial_hidden=True, pallas=False,
+                     pallas_interpret=True, pool=False,
+                     self_interaction=False)
+    out_i = conv_i.apply(params, *args)
+
+    # per-pair reference over the very same params
+    p = params['params']
+    ef = rd[..., None]
+
+    class Trunk(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return radial_hidden(x, 128)
+
+    trunk_params = {'params': {k2: v for k2, v in p.items()
+                               if k2.startswith(('Dense_', 'LayerNorm_'))}}
+    hid = Trunk().apply(trunk_params, ef)
+    for d_out in range(degrees):
+        P = 2 * d_out + 1
+        acc = None
+        for d_in in range(degrees):
+            F = 2 * min(d_in, d_out) + 1
+            x = batched_index_select(feats[str(d_in)], idx, axis=1)
+            v2 = jnp.einsum('...pqf,...cq->...pcf',
+                            basis[f'{d_in},{d_out}'], x)
+            v2 = v2.reshape(*v2.shape[:-2], dim * F)
+            R = jnp.einsum('...m,mko->...ko', hid,
+                           p[f'w3_{d_in}_{d_out}']) + p[f'b3_{d_in}_{d_out}']
+            y = jnp.einsum('...pk,...ko->...po', v2, R)
+            acc = y if acc is None else acc + y
+        ref = jnp.swapaxes(acc, -1, -2)
+        assert np.abs(np.asarray(out[str(d_out)]) - np.asarray(ref)).max() \
+            < 1e-4
+        assert np.abs(np.asarray(out_i[str(d_out)])
+                      - np.asarray(out[str(d_out)])).max() < 1e-4

@@ -40,7 +40,7 @@ def main():
         with jax.default_matmul_precision('highest'):
             ref = jnp.einsum('epk,eko->epo', v2,
                              jnp.einsum('em,mko->eko', h, w3))
-        out = fused_pairwise_conv(h, w3, v2)
+        out = fused_pairwise_conv(h, w3, v2, precision='highest')
         ok &= check(f'pairwise fwd E={E} IF={IF} O={O} P={P}', out, ref)
 
         def f(h, w3, v2):
@@ -49,7 +49,8 @@ def main():
 
         with jax.default_matmul_precision('highest'):
             dh_r, dw3_r, dv2_r = jax.grad(f, argnums=(0, 1, 2))(h, w3, v2)
-        dh, dw3, dv2 = fused_pairwise_conv_bwd(h, w3, v2, g)
+        dh, dw3, dv2 = fused_pairwise_conv_bwd(h, w3, v2, g,
+                                               precision='highest')
         ok &= check(f'pairwise bwd dh  E={E}', dh, dh_r)
         ok &= check(f'pairwise bwd dw3 E={E}', dw3, dw3_r)
         ok &= check(f'pairwise bwd dv2 E={E}', dv2, dv2_r)

@@ -86,13 +86,13 @@ def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
     return 128, min(IF, 8)
 
 
-def _fwd_kernel(ht_ref, w3t_ref, v2t_ref, o_ref, *, P, O, bif):
+def _fwd_kernel(ht_ref, w3t_ref, v2t_ref, o_ref, *, P, O, bif, precision):
     f = pl.program_id(1)
     # R chunk, transposed: [bif*O, E_b] — exists only in VMEM
     rt = jax.lax.dot_general(
         w3t_ref[:], ht_ref[:],
         dimension_numbers=(((1,), (0,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
+        precision=precision,
         preferred_element_type=jnp.float32)
     for p in range(P):
         acc = None
@@ -123,13 +123,16 @@ def _to_lanes(h, w3, v2, g=None):
     return ht, w3t, v2t, gt
 
 
-@functools.partial(jax.jit, static_argnames=('interpret',))
+@functools.partial(jax.jit, static_argnames=('interpret', 'precision'))
 def fused_pairwise_conv(h: jnp.ndarray, w3: jnp.ndarray, v2: jnp.ndarray,
-                        interpret: bool = False) -> jnp.ndarray:
+                        interpret: bool = False,
+                        precision=None) -> jnp.ndarray:
     """h [E, mid], w3 [mid, IF, O], v2 [E, P, IF] -> out [E, P, O] (f32).
 
     Fold the radial bias by appending a ones column to h and the bias row
-    to w3 before calling (see PairwiseConvSE3).
+    to w3 before calling (see PairwiseConvSE3). `precision` feeds the
+    in-kernel MXU dots (captured from jax.default_matmul_precision by the
+    caller — the kernel body traces outside that context).
     """
     E, mid = h.shape
     _, IF, O = w3.shape
@@ -149,7 +152,8 @@ def fused_pairwise_conv(h: jnp.ndarray, w3: jnp.ndarray, v2: jnp.ndarray,
     n_e, n_if = Ep // block_e, IFp // block_if
 
     outt = pl.pallas_call(
-        functools.partial(_fwd_kernel, P=P, O=O, bif=block_if),
+        functools.partial(_fwd_kernel, P=P, O=O, bif=block_if,
+                          precision=precision),
         grid=(n_e, n_if),
         in_specs=[
             pl.BlockSpec((mid, block_e), lambda e, f: (0, e),
@@ -187,12 +191,12 @@ def pallas_available() -> bool:
 
 
 def _bwd_a_kernel(ht_ref, h_ref, w3t_ref, v2t_ref, gt_ref,
-                  dv2_ref, dw3_ref, *, P, O, bif):
+                  dv2_ref, dw3_ref, *, P, O, bif, precision):
     e = pl.program_id(1)
     rt = jax.lax.dot_general(
         w3t_ref[:], ht_ref[:],
         dimension_numbers=(((1,), (0,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
+        precision=precision,
         preferred_element_type=jnp.float32)          # [bif*O, E_b]
     g = gt_ref[:]                                    # [P*O, E_b]
     for i in range(bif):
@@ -211,7 +215,7 @@ def _bwd_a_kernel(ht_ref, h_ref, w3t_ref, v2t_ref, gt_ref,
         upd = jax.lax.dot_general(
             dr_i, h_ref[:],
             dimension_numbers=(((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
+            precision=precision,
             preferred_element_type=jnp.float32)      # [O, mid]
         sl = slice(i * O, (i + 1) * O)
 
@@ -224,7 +228,8 @@ def _bwd_a_kernel(ht_ref, h_ref, w3t_ref, v2t_ref, gt_ref,
             dw3_ref[sl, :] = dw3_ref[sl, :] + upd.astype(dw3_ref.dtype)
 
 
-def _bwd_b_kernel(w3f_ref, v2t_ref, gt_ref, dh_ref, *, P, O, bif):
+def _bwd_b_kernel(w3f_ref, v2t_ref, gt_ref, dh_ref, *, P, O, bif,
+                  precision):
     f = pl.program_id(1)
     g = gt_ref[:]                                    # [P*O, E_b]
     w3f = w3f_ref[0]                                 # [mid, bif*O]
@@ -238,7 +243,7 @@ def _bwd_b_kernel(w3f_ref, v2t_ref, gt_ref, dh_ref, *, P, O, bif):
         upd = jax.lax.dot_general(
             w3f[:, i * O:(i + 1) * O], dr_i,
             dimension_numbers=(((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
+            precision=precision,
             preferred_element_type=jnp.float32)      # [mid, E_b]
         acc = upd if acc is None else acc + upd
 
@@ -251,10 +256,10 @@ def _bwd_b_kernel(w3f_ref, v2t_ref, gt_ref, dh_ref, *, P, O, bif):
         dh_ref[:] = dh_ref[:] + acc.astype(dh_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=('interpret',))
+@functools.partial(jax.jit, static_argnames=('interpret', 'precision'))
 def fused_pairwise_conv_bwd(h: jnp.ndarray, w3: jnp.ndarray,
                             v2: jnp.ndarray, g: jnp.ndarray,
-                            interpret: bool = False):
+                            interpret: bool = False, precision=None):
     """Backward of fused_pairwise_conv: returns (dh, dw3, dv2), all f32.
 
     h [E, mid], w3 [mid, IF, O], v2 [E, P, IF], g [E, P, O].
@@ -282,7 +287,8 @@ def fused_pairwise_conv_bwd(h: jnp.ndarray, w3: jnp.ndarray,
 
     # kernel A: dV2 + dW3 (accumulate over inner e axis)
     dv2t, dw3t = pl.pallas_call(
-        functools.partial(_bwd_a_kernel, P=P, O=O, bif=block_if),
+        functools.partial(_bwd_a_kernel, P=P, O=O, bif=block_if,
+                          precision=precision),
         grid=(n_if, n_e),
         in_specs=[
             pl.BlockSpec((mid, block_e), lambda f, e: (0, e),
@@ -315,7 +321,8 @@ def fused_pairwise_conv_bwd(h: jnp.ndarray, w3: jnp.ndarray,
     # dims (Mosaic block-shape rule).
     w3f3 = w3f.reshape(mid, n_if, block_if * O).transpose(1, 0, 2)
     dht = pl.pallas_call(
-        functools.partial(_bwd_b_kernel, P=P, O=O, bif=block_if),
+        functools.partial(_bwd_b_kernel, P=P, O=O, bif=block_if,
+                          precision=precision),
         grid=(n_e, n_if),
         in_specs=[
             pl.BlockSpec((1, mid, block_if * O), lambda e, f: (f, 0, 0),

@@ -79,23 +79,26 @@ def radial_hidden(x: jnp.ndarray, mid_dim: int) -> jnp.ndarray:
     return x
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _pairwise_contract_pallas(h, w3b, v2, interpret=False):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _pairwise_contract_pallas(h, w3b, v2, interpret=False, precision=None):
     from ..kernels.pallas_pairwise import fused_pairwise_conv
-    return fused_pairwise_conv(h, w3b, v2, interpret=interpret)
+    return fused_pairwise_conv(h, w3b, v2, interpret=interpret,
+                               precision=precision)
 
 
-def _pc_fwd(h, w3b, v2, interpret=False):
-    return _pairwise_contract_pallas(h, w3b, v2, interpret), (h, w3b, v2)
+def _pc_fwd(h, w3b, v2, interpret=False, precision=None):
+    return (_pairwise_contract_pallas(h, w3b, v2, interpret, precision),
+            (h, w3b, v2))
 
 
-def _pc_bwd(interpret, res, g):
+def _pc_bwd(interpret, precision, res, g):
     # fused backward kernel: dR/R exist only as VMEM chunks (see
     # kernels.pallas_pairwise.fused_pairwise_conv_bwd)
     from ..kernels.pallas_pairwise import fused_pairwise_conv_bwd
     h, w3b, v2 = res
     dh, dw3, dv2 = fused_pairwise_conv_bwd(h, w3b, v2, g,
-                                           interpret=interpret)
+                                           interpret=interpret,
+                                           precision=precision)
     return (dh.astype(h.dtype), dw3.astype(w3b.dtype), dv2.astype(v2.dtype))
 
 
@@ -207,7 +210,12 @@ def _radial_contract(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
         h2 = jnp.concatenate(
             [h2, jnp.ones((E, 1), h2.dtype)], axis=-1)
         w3b = jnp.concatenate([w3, b3[None]], axis=0)
-        out = _pairwise_contract_pallas(h2, w3b, v22, pallas_interpret)
+        # capture the active matmul-precision policy at trace time: the
+        # custom_vjp backward traces outside the model's
+        # default_matmul_precision context, so it must be threaded in
+        prec = jax.config.jax_default_matmul_precision
+        out = _pairwise_contract_pallas(h2, w3b, v22, pallas_interpret,
+                                        prec)
         return out.reshape(*lead, P, O)
     R = jnp.einsum('...m,mko->...ko', h, w3) + b3
     return jnp.einsum('...pk,...ko->...po', v2, R)

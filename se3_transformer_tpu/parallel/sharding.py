@@ -49,6 +49,8 @@ _COLUMN_PARALLEL = frozenset({
     'to_k', 'project_in', 'self_interact'})
 _ROW_PARALLEL = frozenset({'to_out', 'project_out'})
 _LINEAR_W = re.compile(r'w\d+$')
+_RADIAL_W3 = re.compile(r'^w3(_\d+_\d+)?$')
+_RADIAL_B3 = re.compile(r'^b3(_\d+_\d+)?$')
 
 
 def _path_names(path):
@@ -70,9 +72,13 @@ def param_partition_specs(params, mesh: Mesh, axis: str = 'tp'):
         names = _path_names(path)
         name = names[-1]
         parent = names[-2] if len(names) > 1 else ''
-        if name == 'w3' and leaf.ndim == 3 and leaf.shape[2] % tp == 0:
+        # radial final weights: per-pair 'w3'/'b3' (PairwiseConvSE3) and
+        # the shared-trunk group layout 'w3_{d_in}_{d_out}' (ConvSE3)
+        if _RADIAL_W3.match(name) and leaf.ndim == 3 \
+                and leaf.shape[2] % tp == 0:
             return P(None, None, axis)
-        if name == 'b3' and leaf.ndim == 2 and leaf.shape[1] % tp == 0:
+        if _RADIAL_B3.match(name) and leaf.ndim == 2 \
+                and leaf.shape[1] % tp == 0:
             return P(None, axis)
         if _LINEAR_W.match(name) and leaf.ndim == 2:
             if parent in _COLUMN_PARALLEL and leaf.shape[1] % tp == 0:

@@ -175,3 +175,29 @@ def test_combined_ring_tp_dp_train_step():
         1 for _, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
         if 'tp' in str(getattr(leaf.sharding, 'spec', '')))
     assert n_sharded >= 4, f'only {n_sharded} params tp-sharded after step'
+
+
+def test_tensor_parallel_shared_radial_group_params():
+    """The shared-radial group layout names its radial weights
+    w3_{d_in}_{d_out}; the tp rules must still shard them over the output
+    channel axis (regression: the rename silently fell through to P())."""
+    from se3_transformer_tpu.parallel import param_partition_specs
+    from se3_transformer_tpu import SE3TransformerModule
+
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    m = SE3TransformerModule(dim=8, depth=1, attend_self=True,
+                             num_neighbors=4, num_degrees=2,
+                             output_degrees=2, shared_radial_hidden=True)
+    rng = np.random.RandomState(0)
+    feats = jnp.asarray(rng.normal(size=(1, 16, 8)), jnp.float32)
+    coors = jnp.asarray(rng.normal(size=(1, 16, 3)), jnp.float32)
+    mask = jnp.ones((1, 16), bool)
+    params = m.init(jax.random.PRNGKey(0), feats, coors, mask=mask,
+                    return_type=1)['params']
+    specs = param_partition_specs(params, mesh)
+    flat = jax.tree_util.tree_flatten_with_path(specs)[0]
+    hits = [(jax.tree_util.keystr(path), spec) for path, spec in flat
+            if 'w3_' in jax.tree_util.keystr(path)]
+    assert hits, 'no group-layout radial weights found'
+    sharded = [s for _, s in hits if 'tp' in str(s)]
+    assert sharded, f'w3_* leaves all replicated: {hits[:4]}'

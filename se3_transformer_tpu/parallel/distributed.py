@@ -39,6 +39,44 @@ def initialize(coordinator_address: Optional[str] = None,
     return True
 
 
+def shard_host_local_batch(batch: dict, mesh) -> dict:
+    """Multi-host analogue of mesh.shard_batch: each process passes its
+    OWN slice of the global batch (what its local data loader produced)
+    and gets back global jax.Arrays laid out by the canonical
+    mesh.data_specs. On a single host this equals shard_batch (minus the
+    replication fallback — multi-host data must divide the mesh axes,
+    anything else silently duplicates examples across hosts).
+
+    The reference has no multi-process input pipeline at all (its loader
+    feeds one cuda device, denoise.py:57-61); this is the TPU-pod
+    equivalent: per-host loaders + jax.make_array_from_process_local_data
+    assembling the logical global batch.
+
+    Unlike shard_batch there is deliberately NO replication fallback for
+    non-dividing axes (that would duplicate examples across hosts, not
+    just waste devices) — such batches raise with an actionable error.
+    Single-host callers who want graceful degradation should use
+    shard_batch.
+    """
+    from jax.sharding import NamedSharding
+    from .mesh import resolve_data_spec
+
+    out = {}
+    for k, v in batch.items():
+        spec = resolve_data_spec(k, v.ndim)
+        for d, axis in enumerate(spec):
+            size = mesh.shape[axis] if isinstance(axis, str) else 1
+            if v.shape[d] % size != 0:
+                raise ValueError(
+                    f"shard_host_local_batch: '{k}' dim {d} (host-local "
+                    f"size {v.shape[d]}) does not divide mesh axis "
+                    f"'{axis}' (size {size}); pad the batch to a multiple "
+                    f"or use mesh.shard_batch (single host only)")
+        sharding = NamedSharding(mesh, spec)
+        out[k] = jax.make_array_from_process_local_data(sharding, v)
+    return out
+
+
 def pod_mesh(dp: Optional[int] = None, sp: Optional[int] = None,
              tp: Optional[int] = None):
     """Mesh over all global devices with ICI-friendly ordering.

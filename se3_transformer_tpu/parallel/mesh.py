@@ -95,6 +95,15 @@ def data_specs() -> dict:
     )
 
 
+def resolve_data_spec(key: str, ndim: int, leading_axes: int = 0) -> P:
+    """Canonical PartitionSpec for one batch entry, truncated/padded to its
+    rank (shared by shard_batch and distributed.shard_host_local_batch so
+    the two placement entry points cannot drift)."""
+    spec = data_specs().get(key, P('dp'))
+    spec = P(*([None] * leading_axes), *spec)
+    return P(*spec[:ndim]) if ndim < len(spec) else spec
+
+
 def shard_batch(batch: dict, mesh: Mesh, leading_axes: int = 0) -> dict:
     """Place a host batch dict onto the mesh with the canonical specs.
 
@@ -104,12 +113,9 @@ def shard_batch(batch: dict, mesh: Mesh, leading_axes: int = 0) -> dict:
     dp>1), so any batch is placeable — but the fallback is LOUD: silently
     replicating would make "sharded training" mean "every device does the
     same work", so each degraded (key, dim) pair warns once."""
-    specs = data_specs()
     out = {}
     for k, v in batch.items():
-        spec = specs.get(k, P('dp'))
-        spec = P(*([None] * leading_axes), *spec)
-        spec = P(*spec[:v.ndim]) if v.ndim < len(spec) else spec
+        spec = resolve_data_spec(k, v.ndim, leading_axes)
         fixed = []
         for d, axis in enumerate(spec):
             if axis is None:

@@ -201,3 +201,24 @@ def test_tensor_parallel_shared_radial_group_params():
     assert hits, 'no group-layout radial weights found'
     sharded = [s for _, s in hits if 'tp' in str(s)]
     assert sharded, f'w3_* leaves all replicated: {hits[:4]}'
+
+
+def test_shard_host_local_batch_single_process():
+    """Single-process case: the per-host batch IS the global batch; output
+    arrays are globally shaped, sharded by the canonical specs, and equal
+    to the plain shard_batch placement."""
+    from se3_transformer_tpu.parallel import distributed, shard_batch
+
+    mesh = make_mesh(dp=2, sp=4)
+    rng = np.random.RandomState(0)
+    batch = dict(
+        feats=rng.randint(0, 10, (2, 16)),
+        coors=rng.normal(size=(2, 16, 3)).astype(np.float32),
+        mask=np.ones((2, 16), bool),
+    )
+    global_arrays = distributed.shard_host_local_batch(batch, mesh)
+    ref = shard_batch({k: jnp.asarray(v) for k, v in batch.items()}, mesh)
+    for k in batch:
+        assert global_arrays[k].shape == batch[k].shape
+        assert str(global_arrays[k].sharding.spec) == str(ref[k].sharding.spec), k
+        assert np.allclose(np.asarray(global_arrays[k]), np.asarray(ref[k]))

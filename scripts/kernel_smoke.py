@@ -78,6 +78,22 @@ def main():
         ok &= check(f'attention BH={BH} BKV={BKV} J={J} D={D} '
                     f'mask={masked}', out, ref)
 
+        gco = jnp.asarray(rng.normal(size=out.shape), jnp.float32)
+
+        def f_ref(q, k, v):
+            return (attention_reference(q, k, v, mask, scale) * gco).sum()
+
+        def f_fused(q, k, v):
+            return (fused_attention(q, k, v, mask, heads, scale)
+                    * gco).sum()
+
+        with jax.default_matmul_precision('highest'):
+            refg = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        outg = jax.grad(f_fused, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip(('dq', 'dk', 'dv'), outg, refg):
+            ok &= check(f'attention bwd {name} BH={BH} BKV={BKV} '
+                        f'mask={masked}', a, b)
+
     print('ALL PASS' if ok else 'FAILURES')
     return 0 if ok else 1
 

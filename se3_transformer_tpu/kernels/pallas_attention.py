@@ -157,6 +157,117 @@ def _fused_attention_fwd_impl(q, k, v, mask, heads: int, scale: float,
     return out[:, :n]
 
 
+# --------------------------------------------------------------------- #
+# fused backward
+# --------------------------------------------------------------------- #
+# Per (node-block, bh) program, recompute sim/attn in VMEM (cheaper than
+# round-tripping them through HBM) and emit all three cotangents:
+#   dv_j  += a_j * g                      (accumulated over the head group)
+#   da_j   = <g, v_j>
+#   dsim_j = a_j * (da_j - sum_l a_l da_l)
+#   dq     = scale * sum_j dsim_j k_j
+#   dk_j  += scale * dsim_j * q           (accumulated over the head group)
+# The grid is (n_e, BH) with bh INNER so the shared-kv dk/dv blocks are
+# revisited on consecutive iterations (the legal accumulation pattern for
+# multi-query attention, group = heads / kv_heads).
+
+
+def _bwd_compute(q, k, v, g, sim, group, scale, dq_ref, dk_ref, dv_ref):
+    bh = pl.program_id(1)
+    m = jnp.max(sim, axis=-1, keepdims=True)
+    p = jnp.exp(sim - m)
+    a = p / jnp.sum(p, axis=-1, keepdims=True)            # [n_b, J]
+    da = jnp.sum(v * g[:, None, :], axis=-1)              # [n_b, J]
+    dsim = a * (da - jnp.sum(a * da, axis=-1, keepdims=True))
+    dq_ref[0] = (scale * jnp.sum(dsim[:, :, None] * k, axis=1)
+                 ).astype(dq_ref.dtype)
+    dk_blk = scale * dsim[:, :, None] * q[:, None, :]     # [n_b, J, D]
+    dv_blk = a[:, :, None] * g[:, None, :]                # [n_b, J, D]
+
+    @pl.when(bh % group == 0)
+    def _():
+        dk_ref[0] = dk_blk.astype(dk_ref.dtype)
+        dv_ref[0] = dv_blk.astype(dv_ref.dtype)
+
+    @pl.when(bh % group != 0)
+    def _():
+        dk_ref[0] = dk_ref[0] + dk_blk.astype(dk_ref.dtype)
+        dv_ref[0] = dv_ref[0] + dv_blk.astype(dv_ref.dtype)
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, mask_ref, g_ref,
+                dq_ref, dk_ref, dv_ref, *, group, scale):
+    q, k, v, g = q_ref[0], k_ref[0], v_ref[0], g_ref[0]
+    sim = jnp.sum(k * q[:, None, :], axis=-1) * scale
+    sim = jnp.where(mask_ref[0], sim, NEG_INF)
+    _bwd_compute(q, k, v, g, sim, group, scale, dq_ref, dk_ref, dv_ref)
+
+
+def _bwd_kernel_nomask(q_ref, k_ref, v_ref, g_ref,
+                       dq_ref, dk_ref, dv_ref, *, group, scale):
+    q, k, v, g = q_ref[0], k_ref[0], v_ref[0], g_ref[0]
+    sim = jnp.sum(k * q[:, None, :], axis=-1) * scale
+    _bwd_compute(q, k, v, g, sim, group, scale, dq_ref, dk_ref, dv_ref)
+
+
+@functools.partial(jax.jit, static_argnames=('heads', 'scale', 'interpret'))
+def _fused_attention_bwd_impl(q, k, v, mask, g, heads: int, scale: float,
+                              interpret: bool = False):
+    BH, n, D = q.shape
+    BKV, _, J, _ = k.shape
+    group = BH // BKV
+
+    # the backward holds ~2x the forward's kv-sized blocks
+    block_n = _pick_block_n(n, J, D, vmem_budget=5 * 2 ** 20)
+    np_ = _round_up(n, block_n)
+    if np_ != n:
+        pad = ((0, 0), (0, np_ - n), (0, 0))
+        q, g = jnp.pad(q, pad), jnp.pad(g, pad)
+        k = jnp.pad(k, ((0, 0), (0, np_ - n), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, np_ - n), (0, 0), (0, 0)))
+        if mask is not None:
+            # padded rows: g is zero there, so grads vanish; keep slots
+            # valid so the recomputed softmax stays finite
+            mask = jnp.pad(mask, ((0, 0), (0, np_ - n), (0, 0)),
+                           constant_values=True)
+
+    q_spec = pl.BlockSpec((1, block_n, D), lambda e, bh: (bh, e, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, block_n, J, D),
+                           lambda e, bh: (bh // group, e, 0, 0),
+                           memory_space=pltpu.VMEM)
+    in_specs = [q_spec, kv_spec, kv_spec]
+    args = [q, k, v]
+    if mask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, block_n, J), lambda e, bh: (bh // heads, e, 0),
+                         memory_space=pltpu.VMEM))
+        args.append(mask)
+        kernel = functools.partial(_bwd_kernel, group=group, scale=scale)
+    else:
+        kernel = functools.partial(_bwd_kernel_nomask, group=group,
+                                   scale=scale)
+    args.append(g)
+    in_specs.append(q_spec)
+
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(np_ // block_n, BH),
+        in_specs=in_specs,
+        out_specs=[q_spec, kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, np_, D), jnp.float32),
+            jax.ShapeDtypeStruct((BKV, np_, J, D), jnp.float32),
+            jax.ShapeDtypeStruct((BKV, np_, J, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    # cotangent dtypes must match the primals (custom_vjp contract); the
+    # kernel accumulates in f32 regardless
+    return (dq[:, :n].astype(q.dtype), dk[:, :n].astype(k.dtype),
+            dv[:, :n].astype(v.dtype))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def fused_attention(q, k, v, mask, heads: int, scale: float,
                     interpret: bool = False):
@@ -172,10 +283,8 @@ def _fa_fwd(q, k, v, mask, heads, scale, interpret):
 
 def _fa_bwd(heads, scale, interpret, res, g):
     q, k, v, mask = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(q_, k_, v_, mask, scale),
-        q, k, v)
-    dq, dk, dv = vjp(g)
+    dq, dk, dv = _fused_attention_bwd_impl(q, k, v, mask, g, heads, scale,
+                                           interpret)
     return dq, dk, dv, None
 
 

@@ -61,17 +61,23 @@ def shard_host_local_batch(batch: dict, mesh) -> dict:
     from jax.sharding import NamedSharding
     from .mesh import resolve_data_spec
 
+    single = jax.process_count() == 1
     out = {}
     for k, v in batch.items():
         spec = resolve_data_spec(k, v.ndim)
-        for d, axis in enumerate(spec):
-            size = mesh.shape[axis] if isinstance(axis, str) else 1
-            if v.shape[d] % size != 0:
-                raise ValueError(
-                    f"shard_host_local_batch: '{k}' dim {d} (host-local "
-                    f"size {v.shape[d]}) does not divide mesh axis "
-                    f"'{axis}' (size {size}); pad the batch to a multiple "
-                    f"or use mesh.shard_batch (single host only)")
+        if single:
+            # exact pre-check only when local == global; multi-process
+            # global-shape assembly is validated by
+            # make_array_from_process_local_data itself (the per-axis
+            # process placement is not knowable from the local view)
+            for d, axis in enumerate(spec):
+                size = mesh.shape[axis] if isinstance(axis, str) else 1
+                if v.shape[d] % size != 0:
+                    raise ValueError(
+                        f"shard_host_local_batch: '{k}' dim {d} (size "
+                        f"{v.shape[d]}) does not divide mesh axis "
+                        f"'{axis}' (size {size}); pad the batch to a "
+                        f"multiple or use mesh.shard_batch")
         sharding = NamedSharding(mesh, spec)
         out[k] = jax.make_array_from_process_local_data(sharding, v)
     return out

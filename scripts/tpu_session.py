@@ -41,6 +41,19 @@ def main():
 
     failed = False
 
+    log('--- kernel_smoke (Mosaic lowering + numerics) ---')
+    try:
+        import kernel_smoke
+        rc = kernel_smoke.main()
+        if rc != 0:
+            failed = True
+            log('kernel_smoke: FAILURES (continuing to gather data)')
+        else:
+            log('kernel_smoke: all pass')
+    except Exception:
+        failed = True
+        log('kernel_smoke FAILED:\n' + traceback.format_exc())
+
     log('--- tpu_checks ---')
     try:
         import tpu_checks as tc

@@ -28,11 +28,12 @@ Multi-query attention (kv_heads < heads) is handled in the index maps:
 query-head programs map onto their shared kv head, so the 1-head k/v is
 never materialized per query head.
 
-Backward: the op is wrapped in jax.custom_vjp with the XLA reference
-implementation's VJP (attention backward is matmul-shaped and XLA-fuses
-well; the forward fusion is where the HBM win is). Numerics are gated
-against the XLA path in tests (interpreter mode) and on-chip
-(scripts/tpu_checks.py).
+Backward: a second fused kernel (custom_vjp) recomputes sim/softmax in
+VMEM and emits dq/dk/dv in one kv pass — grid (n_blocks, bh) with bh
+inner so shared-kv dk/dv blocks accumulate over consecutive
+query-head-group iterations (multi-query). Numerics are gated against
+the XLA path in tests (interpreter mode) and on-chip
+(scripts/kernel_smoke.py, scripts/tpu_checks.py).
 """
 from __future__ import annotations
 

@@ -198,19 +198,24 @@ def test_fused_attention_gradients():
         attention_reference, fused_attention,
     )
     rng = np.random.RandomState(1)
-    B, h, kv_h, n, J, D = 1, 2, 2, 12, 6, 8
-    q = jnp.asarray(rng.normal(size=(B * h, n, D)), jnp.float32)
-    k = jnp.asarray(rng.normal(size=(B * kv_h, n, J, D)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(B * kv_h, n, J, D)), jnp.float32)
-    mask = jnp.ones((B, n, J), bool)
-    scale = D ** -0.5
+    # (h, kv_h): group=1 and the multi-query group>1 accumulation branch;
+    # ragged mask exercises the masked-slot gradient path
+    for h, kv_h in ((2, 2), (4, 1)):
+        B, n, J, D = 1, 12, 6, 8
+        q = jnp.asarray(rng.normal(size=(B * h, n, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B * kv_h, n, J, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B * kv_h, n, J, D)), jnp.float32)
+        mask = jnp.asarray(rng.rand(B, n, J) > 0.3).at[:, :, 0].set(True)
+        scale = D ** -0.5
 
-    g_f = jax.grad(lambda q, k, v: (fused_attention(
-        q, k, v, mask, h, scale, True) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
-    g_r = jax.grad(lambda q, k, v: (attention_reference(
-        q, k, v, mask, scale) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
-    for a, b in zip(g_f, g_r):
-        assert np.abs(np.asarray(a) - np.asarray(b)).max() < 1e-4
+        g_f = jax.grad(lambda q, k, v: (fused_attention(
+            q, k, v, mask, h, scale, True) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        g_r = jax.grad(lambda q, k, v: (attention_reference(
+            q, k, v, mask, scale) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_f, g_r):
+            assert np.abs(np.asarray(a) - np.asarray(b)).max() < 1e-4, \
+                (h, kv_h)
 
 
 def test_model_with_fused_attention_matches_einsum_path():

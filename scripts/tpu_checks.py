@@ -10,7 +10,11 @@ Checks:
 
 Usage: python scripts/tpu_checks.py
 """
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -29,12 +33,16 @@ def check_equivariance(precision: str):
     feats = jnp.asarray(rng.normal(size=(1, 32, 16)), jnp.float32)
     coors = jnp.asarray(rng.normal(size=(1, 32, 3)), jnp.float32)
     mask = jnp.ones((1, 32), bool)
+    # jit the init: eager init dispatches thousands of tiny ops through the
+    # device tunnel (minutes of latency); one compiled program is seconds
+    init_fn = jax.jit(module.init, static_argnames=('return_type',))
     with jax.default_matmul_precision(precision):
-        params = module.init(jax.random.PRNGKey(0), feats, coors, mask=mask,
-                             return_type=1)['params']
+        params = init_fn(jax.random.PRNGKey(0), feats, coors, mask=mask,
+                         return_type=1)['params']
     err = equivariance_l2(module, params, feats, coors, mask,
                           precision=precision)
-    scale = float(np.abs(np.asarray(module.apply(
+    apply_fn = jax.jit(module.apply, static_argnames=('return_type',))
+    scale = float(np.abs(np.asarray(apply_fn(
         {'params': params}, feats, coors, mask=mask, return_type=1))).max())
     return err, err / max(scale, 1e-12)
 
@@ -57,9 +65,10 @@ def check_equivariance_sparse_only(precision: str = 'float32'):
     seq = np.arange(n)
     adj = jnp.asarray((seq[:, None] >= seq[None, :] - 1)
                       & (seq[:, None] <= seq[None, :] + 1))
+    init_fn = jax.jit(module.init, static_argnames=('return_type',))
     with jax.default_matmul_precision(precision):
-        params = module.init(jax.random.PRNGKey(0), feats, coors, mask=mask,
-                             adj_mat=adj, return_type=1)['params']
+        params = init_fn(jax.random.PRNGKey(0), feats, coors, mask=mask,
+                         adj_mat=adj, return_type=1)['params']
     return equivariance_l2(module, params, feats, coors, mask,
                            precision=precision, adj_mat=adj)
 
@@ -87,7 +96,7 @@ def bench_conv(pallas: bool, n=512, k=24, dim=32, degrees=3, iters=10):
         return feats, (idx, mask, None), rel_dist, basis
 
     args = run(feats, coors)
-    params = conv.init(jax.random.PRNGKey(0), *args)
+    params = jax.jit(conv.init)(jax.random.PRNGKey(0), *args)
     fwd = jax.jit(lambda p, a: conv.apply(p, *a))
     out = jax.block_until_ready(fwd(params, args))
 
@@ -128,8 +137,8 @@ def check_fused_backward(n=256, k=16, dim=24, degrees=3,
                       pallas_interpret=True) if interpret \
         else ConvSE3(fiber, fiber, pallas=True)
     conv_x = ConvSE3(fiber, fiber, pallas=False)
-    params = conv_x.init(jax.random.PRNGKey(0), feats, (idx, mask, None),
-                         rd, basis)
+    params = jax.jit(conv_x.init)(jax.random.PRNGKey(0), feats,
+                                  (idx, mask, None), rd, basis)
 
     def loss(conv):
         return lambda p: sum(

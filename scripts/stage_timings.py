@@ -1,0 +1,162 @@
+"""Per-stage wall-clock breakdown of the flagship step (VERDICT #2).
+
+Times each pipeline stage as its own jitted program on the current
+backend: neighbor selection, basis construction, one ConvSE3, one
+attention block, the full forward, and the full train step (fwd+bwd+
+optimizer). Stage programs re-do upstream work (a conv needs neighbors
+and basis), so the isolated numbers don't sum to the full step — they
+bound each stage from above and show where the time goes.
+
+Usage: python scripts/stage_timings.py [--nodes 1024] [--dim 8]
+       [--degrees 4] [--neighbors 32] [--depth 2] [--iters 10] [--cpu]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(fn, args, iters):
+    out = jax.block_until_ready(fn(*args))  # compile
+    t0 = time.time()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.time() - t0) / iters * 1e3  # ms
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--nodes', type=int, default=1024)
+    ap.add_argument('--dim', type=int, default=8)
+    ap.add_argument('--degrees', type=int, default=4)
+    ap.add_argument('--neighbors', type=int, default=32)
+    ap.add_argument('--depth', type=int, default=2)
+    ap.add_argument('--heads', type=int, default=2)
+    ap.add_argument('--iters', type=int, default=10)
+    ap.add_argument('--no-pallas', action='store_true')
+    ap.add_argument('--cpu', action='store_true')
+    args = ap.parse_args(argv)
+
+    global jax
+    import jax
+    if args.cpu:
+        jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from se3_transformer_tpu.basis import get_basis
+    from se3_transformer_tpu.models.se3_transformer import SE3TransformerModule
+    from se3_transformer_tpu.ops import AttentionBlockSE3, ConvSE3, Fiber
+    from se3_transformer_tpu.ops.neighbors import (
+        exclude_self_indices, remove_self, select_neighbors,
+    )
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    enable_compilation_cache()
+
+    b, n, k, deg, dim = 1, args.nodes, args.neighbors, args.degrees, args.dim
+    pallas = False if args.no_pallas else None
+    rng = np.random.RandomState(0)
+    coords = jnp.asarray(np.cumsum(rng.normal(size=(b, n, 3)), axis=1),
+                         jnp.float32)
+    mask = jnp.ones((b, n), bool)
+    report = {'backend': jax.default_backend(), 'config': vars(args),
+              'stage_ms': {}}
+
+    # --- neighbor selection (O(N^2) distance + static-K top-k), on the
+    # model's self-excluded [b, n, n-1] layout (exclude_self_indices) ---
+    self_excl = exclude_self_indices(n)
+    idx_base = jnp.broadcast_to(self_excl[None], (b, n, n - 1))
+
+    def neighbors_fn(coords):
+        rel_pos = coords[:, :, None, :] - coords[:, None, :, :]
+        rel_pos = remove_self(rel_pos, self_excl)
+        return select_neighbors(rel_pos, idx_base, k, 1e5,
+                                pair_mask=None, neighbor_mask=None)
+
+    hood, nearest = jax.jit(neighbors_fn)(coords)
+    report['stage_ms']['neighbors'] = timeit(
+        jax.jit(neighbors_fn), (coords,), args.iters)
+
+    # --- basis construction on the selected edges ---
+    basis_fn = jax.jit(lambda rp: get_basis(rp, deg - 1))
+    basis = basis_fn(hood.rel_pos)
+    report['stage_ms']['basis'] = timeit(
+        basis_fn, (hood.rel_pos,), args.iters)
+
+    # --- one ConvSE3 at trunk width ---
+    fiber = Fiber.create(deg, dim)
+    feats = {str(d): jnp.asarray(
+        rng.normal(size=(b, n, dim, 2 * d + 1)), jnp.float32)
+        for d in range(deg)}
+    conv = ConvSE3(fiber, fiber, pallas=pallas, shared_radial_hidden=True)
+    edge_info = (hood.indices, hood.mask, None)
+    cargs = (feats, edge_info, hood.rel_dist, basis)
+    cparams = jax.jit(conv.init)(jax.random.PRNGKey(0), *cargs)
+    conv_fn = jax.jit(lambda p, f: conv.apply(p, f, *cargs[1:]))
+    report['stage_ms']['conv'] = timeit(conv_fn, (cparams, feats), args.iters)
+
+    # --- one attention block at trunk width ---
+    attn = AttentionBlockSE3(fiber=fiber, dim_head=max(8, dim // 2),
+                             heads=args.heads, attend_self=True,
+                             pallas=pallas,
+                             shared_radial_hidden=True)
+    aparams = jax.jit(attn.init)(jax.random.PRNGKey(0), *cargs)
+    attn_fn = jax.jit(lambda p, f: attn.apply(p, f, *cargs[1:]))
+    report['stage_ms']['attention_block'] = timeit(
+        attn_fn, (aparams, feats), args.iters)
+
+    # --- full model forward / train step (denoise-style flagship) ---
+    module = SE3TransformerModule(
+        num_tokens=24, dim=dim, dim_head=max(8, dim), heads=args.heads,
+        depth=args.depth, attend_self=True, input_degrees=1, num_degrees=deg,
+        output_degrees=2, reduce_dim_out=True, differentiable_coors=True,
+        num_neighbors=k, pallas=pallas)
+    seqs = jnp.asarray(rng.randint(0, 24, (b, n)))
+    params = jax.jit(module.init, static_argnames=('return_type',))(
+        jax.random.PRNGKey(0), seqs, coords, mask=mask,
+        return_type=1)['params']
+    fwd = jax.jit(lambda p, c: module.apply(
+        {'params': p}, seqs, c, mask=mask, return_type=1))
+    report['stage_ms']['model_forward'] = timeit(
+        fwd, (params, coords), args.iters)
+
+    opt = optax.adam(1e-4)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, coords, key):
+        noise = jax.random.normal(key, coords.shape, coords.dtype)
+        noised = coords + noise
+        out = module.apply({'params': p}, seqs, noised, mask=mask,
+                           return_type=1)
+        return (((noised + out) - coords) ** 2).sum(-1).mean()
+
+    @jax.jit
+    def train_step(p, opt_state, coords, key):
+        loss, grads = jax.value_and_grad(loss_fn)(p, coords, key)
+        updates, opt_state = opt.update(grads, opt_state, p)
+        return optax.apply_updates(p, updates), opt_state, loss
+
+    key = jax.random.PRNGKey(1)
+    p2, o2, loss = train_step(params, opt_state, coords, key)
+    jax.block_until_ready(loss)
+    t0 = time.time()
+    for _ in range(args.iters):
+        p2, o2, loss = train_step(p2, o2, coords, key)
+    jax.block_until_ready(loss)
+    report['stage_ms']['train_step'] = (time.time() - t0) / args.iters * 1e3
+
+    report['stage_ms'] = {s: round(v, 3)
+                          for s, v in report['stage_ms'].items()}
+    print(json.dumps(report))
+    return report
+
+
+if __name__ == '__main__':
+    main()

@@ -29,7 +29,15 @@ def log(msg):
 def main():
     log(f'pid={os.getpid()} waiting for TPU (blocking, no timeout)...')
     import jax
-    devs = jax.devices()
+    try:
+        devs = jax.devices()
+    except RuntimeError as e:
+        # the tunnel can also FAIL init outright (not just block) while
+        # recovering; that state is retryable only from a fresh process
+        # (jax caches the failed backend) — exit 3 so a supervisor can
+        # relaunch us (scripts/tpu_session_loop.sh retries on rc=3)
+        log(f'backend unavailable (retryable): {e}')
+        return 3
     log(f'devices: {devs}')
     if jax.default_backend() != 'tpu':
         log('backend is not tpu — aborting (nothing to validate)')
@@ -71,6 +79,15 @@ def main():
     except Exception:
         failed = True
         log('bench FAILED:\n' + traceback.format_exc())
+
+    log('--- stage timings (flagship bench config) ---')
+    try:
+        import stage_timings
+        rep = stage_timings.main([])
+        log(f'stage_timings: {rep["stage_ms"]}')
+    except Exception:
+        failed = True
+        log('stage_timings FAILED:\n' + traceback.format_exc())
 
     log('--- baseline configs ---')
     try:

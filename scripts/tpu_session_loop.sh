@@ -1,0 +1,12 @@
+#!/bin/bash
+# Relaunch tpu_session until it actually gets the chip (rc!=3) — the
+# tunnel alternates between blocking (session waits inside) and failing
+# init outright (rc=3, needs a fresh process).
+cd /root/repo
+while true; do
+  python scripts/tpu_session.py /tmp/tpu_session_r2.log
+  rc=$?
+  echo "[loop] session rc=$rc at $(date -u +%H:%M:%S)" >> /tmp/tpu_session_r2.log
+  if [ "$rc" != "3" ]; then exit $rc; fi
+  sleep 60
+done

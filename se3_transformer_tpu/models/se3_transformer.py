@@ -120,8 +120,9 @@ class SE3TransformerModule(nn.Module):
     matmul_precision: Optional[str] = None
     # share one radial hidden trunk across degree pairs (perf option)
     shared_radial_hidden: bool = False
-    # stream the node axis through the pairwise contraction in N chunks
-    # (XLA path; memory ceiling for huge channel counts)
+    # stream the node axis through the pairwise contraction in N remat'd
+    # chunks (memory ceiling for huge channel counts; composes with the
+    # Pallas kernel, which then bounds VMEM within each chunk)
     edge_chunks: Optional[int] = None
     # 'ring' = sequence-parallel neighbor selection: exact kNN via a ring
     # of ppermutes over `mesh`'s sp axis (parallel.ring), so the O(N^2)

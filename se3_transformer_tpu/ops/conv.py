@@ -171,7 +171,10 @@ def _radial_contract(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
                      edge_chunks: Optional[int]) -> jnp.ndarray:
     """Dispatch the fused radial-matmul x basis contraction:
     h [b,n,k,mid], w3 [mid,IF,O], b3 [IF,O], v2 [b,n,k,P,IF]
-    -> [b,n,k,P,O] via the Pallas kernel / XLA einsums / chunked-remat."""
+    -> [b,n,k,P,O] via the Pallas kernel / XLA einsums, optionally
+    streaming the node axis in `edge_chunks` remat'd chunks (memory
+    ceiling for huge channel counts: peak extra memory is one chunk's
+    R — XLA path — or just the kernel's VMEM tiles — Pallas path)."""
     P, IF = v2.shape[-2], v2.shape[-1]
     O = w3.shape[-1]
     lead = h.shape[:-1]
@@ -179,46 +182,42 @@ def _radial_contract(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
     use_pallas = pallas
     if use_pallas is None:
         use_pallas = jax.default_backend() == 'tpu'
+    use_pallas = use_pallas or pallas_interpret
 
-    if edge_chunks is not None:
-        # explicit edge_chunks takes precedence over the Pallas kernel
-        # (the kernel bounds VMEM, but at huge channel counts the HBM
-        # h/v2/out tensors themselves need node-axis streaming): the
-        # per-chunk R tensor is rematerialized in the backward, so peak
-        # memory is bounded by the chunk size in both passes
-        n = h.shape[1]
-        c = edge_chunks
-        assert n % c == 0, f'nodes {n} must divide into {c} edge_chunks'
+    if use_pallas:
+        # fold bias once: ones column on h (appended per chunk), bias row
+        # on w3. Capture the active matmul-precision policy at trace time:
+        # the custom_vjp backward traces outside the model's
+        # default_matmul_precision context, so it must be threaded in.
+        w3b = jnp.concatenate([w3, b3[None]], axis=0)
+        prec = jax.config.jax_default_matmul_precision
 
-        def chunk_fn(args):
-            h_c, v2_c = args
+        def contract(h_c, v2_c):
+            lead_c = h_c.shape[:-1]
+            E = 1
+            for s in lead_c:
+                E *= s
+            h2 = h_c.reshape(E, h_c.shape[-1])
+            h2 = jnp.concatenate([h2, jnp.ones((E, 1), h2.dtype)], axis=-1)
+            out = _pairwise_contract_pallas(h2, w3b, v2_c.reshape(E, P, IF),
+                                            pallas_interpret, prec)
+            return out.reshape(*lead_c, P, O)
+    else:
+        def contract(h_c, v2_c):
             R = jnp.einsum('...m,mko->...ko', h_c, w3) + b3
             return jnp.einsum('...pk,...ko->...po', v2_c, R)
 
-        h_s = h.reshape(h.shape[0], c, n // c, *h.shape[2:])
-        v2_s = v2.reshape(v2.shape[0], c, n // c, *v2.shape[2:])
-        h_s, v2_s = jnp.swapaxes(h_s, 0, 1), jnp.swapaxes(v2_s, 0, 1)
-        out = jax.lax.map(jax.checkpoint(chunk_fn), (h_s, v2_s))
-        return jnp.swapaxes(out, 0, 1).reshape(*lead, P, O)
-    if use_pallas or pallas_interpret:
-        E = 1
-        for s in lead:
-            E *= s
-        h2 = h.reshape(E, h.shape[-1])
-        v22 = v2.reshape(E, P, IF)
-        # fold bias: ones column on h, bias row on w3
-        h2 = jnp.concatenate(
-            [h2, jnp.ones((E, 1), h2.dtype)], axis=-1)
-        w3b = jnp.concatenate([w3, b3[None]], axis=0)
-        # capture the active matmul-precision policy at trace time: the
-        # custom_vjp backward traces outside the model's
-        # default_matmul_precision context, so it must be threaded in
-        prec = jax.config.jax_default_matmul_precision
-        out = _pairwise_contract_pallas(h2, w3b, v22, pallas_interpret,
-                                        prec)
-        return out.reshape(*lead, P, O)
-    R = jnp.einsum('...m,mko->...ko', h, w3) + b3
-    return jnp.einsum('...pk,...ko->...po', v2, R)
+    if edge_chunks is None:
+        return contract(h, v2)
+
+    n = h.shape[1]
+    c = edge_chunks
+    assert n % c == 0, f'nodes {n} must divide into {c} edge_chunks'
+    h_s = h.reshape(h.shape[0], c, n // c, *h.shape[2:])
+    v2_s = v2.reshape(v2.shape[0], c, n // c, *v2.shape[2:])
+    h_s, v2_s = jnp.swapaxes(h_s, 0, 1), jnp.swapaxes(v2_s, 0, 1)
+    out = jax.lax.map(jax.checkpoint(lambda a: contract(*a)), (h_s, v2_s))
+    return jnp.swapaxes(out, 0, 1).reshape(*lead, P, O)
 
 
 def pairwise_conv_contract(R: jnp.ndarray, B: jnp.ndarray,

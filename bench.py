@@ -169,6 +169,7 @@ def main(backend: str):
             step_flops / (dt / steps) / 197e12, 4)
         record['step_tflops'] = round(step_flops / 1e12, 3)
     print(json.dumps(record))
+    return record
 
 
 if __name__ == '__main__':

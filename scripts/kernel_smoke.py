@@ -22,6 +22,10 @@ def check(name, a, b, tol=1e-4):
 
 
 def main():
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    enable_compilation_cache()
     print('backend:', jax.default_backend())
     rng = np.random.RandomState(0)
     ok = True

@@ -88,14 +88,18 @@ def bench_conv(pallas: bool, n=512, k=24, dim=32, degrees=3, iters=10):
 
     conv = ConvSE3(fiber, fiber, pallas=pallas)
 
-    def run(feats, coors):
+    # jit the input prep: eager gathers/basis would round-trip thousands of
+    # tiny ops through the device tunnel (minutes of latency)
+    @jax.jit
+    def prep(coors):
         coors_j = batched_index_select(coors, idx, axis=1)
         rel_pos = coors[:, :, None, :] - coors_j
         rel_dist = jnp.linalg.norm(rel_pos, axis=-1)
         basis = get_basis(rel_pos, degrees - 1)
-        return feats, (idx, mask, None), rel_dist, basis
+        return rel_dist, basis
 
-    args = run(feats, coors)
+    rel_dist, basis = prep(coors)
+    args = (feats, (idx, mask, None), rel_dist, basis)
     params = jax.jit(conv.init)(jax.random.PRNGKey(0), *args)
     fwd = jax.jit(lambda p, a: conv.apply(p, *a))
     out = jax.block_until_ready(fwd(params, args))
@@ -128,10 +132,14 @@ def check_fused_backward(n=256, k=16, dim=24, degrees=3,
     coors = jnp.asarray(rng.normal(size=(1, n, 3)) * 3, jnp.float32)
     idx = jnp.asarray(rng.randint(0, n, (1, n, k)), jnp.int32)
     mask = jnp.ones((1, n, k), bool)
-    coors_j = batched_index_select(coors, idx, axis=1)
-    rel = coors[:, :, None, :] - coors_j
-    rd = jnp.linalg.norm(rel, axis=-1)
-    basis = get_basis(rel, degrees - 1)
+    @jax.jit
+    def prep(coors):
+        coors_j = batched_index_select(coors, idx, axis=1)
+        rel = coors[:, :, None, :] - coors_j
+        rd = jnp.linalg.norm(rel, axis=-1)
+        return rd, get_basis(rel, degrees - 1)
+
+    rd, basis = prep(coors)
 
     conv_pl = ConvSE3(fiber, fiber, pallas=False,
                       pallas_interpret=True) if interpret \
@@ -186,6 +194,10 @@ def bench_attention(fused: bool, B=1, h=8, n=1024, J=33, D=56, iters=20):
 
 
 def main():
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    enable_compilation_cache()
     print(f'backend: {jax.default_backend()}')
 
     for prec in ('float32', 'bfloat16'):

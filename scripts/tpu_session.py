@@ -4,9 +4,12 @@ The axon tunnel is single-client and wedges when a claim-holding process
 is killed. So this script NEVER times itself out: if the chip is busy or
 wedged it blocks harmlessly at backend init (a blocked waiter holds no
 claim) and proceeds the moment the lease frees up. Once it has the chip
-it runs the full on-chip suite in ONE process — tpu_checks (equivariance
-at f32/bf16, fused Pallas kernel numerics + speedup) and then the
-flagship benchmark — and exits cleanly so the chip is released.
+it runs the full on-chip suite in ONE process — the kernel_smoke canary,
+the flagship benchmark (the round's key deliverable, so it runs before
+the longer checks in case the tunnel dies mid-session), tpu_checks
+(equivariance at f32/bf16, fused Pallas kernel numerics + speedup),
+stage timings, baseline configs, profile — and exits cleanly so the
+chip is released.
 
 Usage: python scripts/tpu_session.py [logfile]
 """
@@ -40,12 +43,22 @@ def main():
         return 3
     log(f'devices: {devs}')
     if jax.default_backend() != 'tpu':
-        log('backend is not tpu — aborting (nothing to validate)')
-        return 1
+        # jax can also fall back to CPU silently when the tunnel's plugin
+        # fails init — that's the same retryable condition as the
+        # RuntimeError above, not a terminal config error
+        log('backend is not tpu (tunnel down? retryable) — exiting 3')
+        return 3
 
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, os.path.dirname(here))  # repo root (bench, package)
     sys.path.insert(0, here)                   # scripts/ (tpu_checks)
+
+    # persist compiles across session relaunches: the tunnel can die
+    # mid-session and every recompile over it costs minutes
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    log(f'compilation cache: {enable_compilation_cache()}')
 
     failed = False
 
@@ -62,6 +75,15 @@ def main():
         failed = True
         log('kernel_smoke FAILED:\n' + traceback.format_exc())
 
+    log('--- flagship bench ---')
+    try:
+        import bench
+        rec = bench.main('tpu')
+        log(f'bench: {rec}')
+    except Exception:
+        failed = True
+        log('bench FAILED:\n' + traceback.format_exc())
+
     log('--- tpu_checks ---')
     try:
         import tpu_checks as tc
@@ -70,15 +92,6 @@ def main():
     except Exception:
         failed = True
         log('tpu_checks FAILED:\n' + traceback.format_exc())
-
-    log('--- flagship bench ---')
-    try:
-        import bench
-        bench.main('tpu')
-        log('bench: completed')
-    except Exception:
-        failed = True
-        log('bench FAILED:\n' + traceback.format_exc())
 
     log('--- stage timings (flagship bench config) ---')
     try:

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Wait for any in-flight tpu_session (or an already-running retry loop)
+# to exit — a blocked waiter may resume when the tunnel returns: never
+# kill it, never race it — then keep relaunching fresh sessions until
+# one completes with the chip. Log file is the loop's hardcoded
+# /tmp/tpu_session_r2.log (keep in sync with tpu_session_loop.sh).
+cd /root/repo || exit 1
+LOG=/tmp/tpu_session_r2.log
+# only a success logged AFTER this point counts — the log is append-only
+# across rounds and an old "session done (ok)" must not suppress a rerun
+START_LINES=$(wc -l < "$LOG" 2>/dev/null || echo 0)
+while pgrep -f "scripts/tpu_session.py" > /dev/null \
+    || pgrep -f "tpu_session_loop.sh" > /dev/null; do
+  sleep 60
+done
+if tail -n +$((START_LINES + 1)) "$LOG" 2>/dev/null \
+    | grep -q "session done (ok)"; then
+  echo "[supervisor] session succeeded while we waited, nothing to do" >> "$LOG"
+  exit 0
+fi
+echo "[supervisor] prior session gone, starting loop $(date -u +%H:%M:%S)" >> "$LOG"
+exec bash scripts/tpu_session_loop.sh

@@ -59,6 +59,25 @@ def main():
         ok &= check(f'pairwise bwd dw3 E={E}', dw3, dw3_r)
         ok &= check(f'pairwise bwd dv2 E={E}', dv2, dv2_r)
 
+    # --- basis-fused pairwise kernel (forward; bwd shares the kernels
+    # gated above via the reconstruct-VJP) ---
+    from se3_transformer_tpu.kernels.pallas_pairwise import (
+        fused_pairwise_conv_bx,
+    )
+    for (E, mid, C, Q, F, O, P) in [(300, 129, 8, 3, 3, 8, 5),
+                                    (64, 129, 9, 5, 3, 4, 5),
+                                    (1000, 129, 8, 7, 7, 8, 7)]:
+        h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
+        w3 = jnp.asarray(rng.normal(size=(mid, C * F, O)), jnp.float32)
+        bas = jnp.asarray(rng.normal(size=(E, P, Q, F)), jnp.float32)
+        x = jnp.asarray(rng.normal(size=(E, C, Q)), jnp.float32)
+        with jax.default_matmul_precision('highest'):
+            v2 = jnp.einsum('epqf,ecq->epcf', bas, x).reshape(E, P, C * F)
+            ref = jnp.einsum('epk,eko->epo', v2,
+                             jnp.einsum('em,mko->eko', h, w3))
+        out = fused_pairwise_conv_bx(h, w3, bas, x, precision='highest')
+        ok &= check(f'pairwise bx fwd E={E} C={C} Q={Q} F={F}', out, ref)
+
     # --- attention kernel ---
     from se3_transformer_tpu.kernels.pallas_attention import (
         attention_reference, fused_attention,

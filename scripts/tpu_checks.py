@@ -73,7 +73,8 @@ def check_equivariance_sparse_only(precision: str = 'float32'):
                            precision=precision, adj_mat=adj)
 
 
-def bench_conv(pallas: bool, n=512, k=24, dim=32, degrees=3, iters=10):
+def bench_conv(pallas: bool, n=512, k=24, dim=32, degrees=3, iters=10,
+               fuse_basis=False):
     from se3_transformer_tpu.basis import get_basis
     from se3_transformer_tpu.ops import ConvSE3, Fiber
     from se3_transformer_tpu.utils import batched_index_select
@@ -86,7 +87,7 @@ def bench_conv(pallas: bool, n=512, k=24, dim=32, degrees=3, iters=10):
     idx = jnp.asarray(rng.randint(0, n, (1, n, k)), jnp.int32)
     mask = jnp.ones((1, n, k), bool)
 
-    conv = ConvSE3(fiber, fiber, pallas=pallas)
+    conv = ConvSE3(fiber, fiber, pallas=pallas, fuse_basis=fuse_basis)
 
     # jit the input prep: eager gathers/basis would round-trip thousands of
     # tiny ops through the device tunnel (minutes of latency)
@@ -221,6 +222,13 @@ def main():
     print(f'ConvSE3 fwd: xla {t_xla*1e3:.1f} ms, pallas {t_pl*1e3:.1f} ms '
           f'({t_xla/t_pl:.2f}x), max|diff|={diff:.2e} '
           f'[{"PASS" if diff < 1e-3 else "FAIL"}]')
+
+    t_bx, out_bx = bench_conv(pallas=True, fuse_basis=True)
+    diff = max(float(jnp.abs(out_xla[d] - out_bx[d]).max())
+               for d in out_xla)
+    print(f'ConvSE3 fwd fuse_basis: {t_bx*1e3:.1f} ms '
+          f'({t_xla/t_bx:.2f}x vs xla, {t_pl/t_bx:.2f}x vs pallas), '
+          f'max|diff|={diff:.2e} [{"PASS" if diff < 1e-3 else "FAIL"}]')
 
     t_ax, out_ax = bench_attention(fused=False)
     t_af, out_af = bench_attention(fused=True)

@@ -5,6 +5,14 @@
 # one completes with the chip. Log file is the loop's hardcoded
 # /tmp/tpu_session_r2.log (keep in sync with tpu_session_loop.sh).
 cd /root/repo || exit 1
+# single-instance lock: two supervisors waking together would exec two
+# session loops and race for the single-client tunnel
+LOCK=/tmp/tpu_supervisor.lock
+if ! mkdir "$LOCK" 2>/dev/null; then
+  echo "[supervisor] another instance holds $LOCK, exiting" >&2
+  exit 0
+fi
+trap 'rmdir "$LOCK" 2>/dev/null' EXIT
 LOG=/tmp/tpu_session_r2.log
 # only a success logged AFTER this point counts — the log is append-only
 # across rounds and an old "session done (ok)" must not suppress a rerun
@@ -19,4 +27,6 @@ if tail -n +$((START_LINES + 1)) "$LOG" 2>/dev/null \
   exit 0
 fi
 echo "[supervisor] prior session gone, starting loop $(date -u +%H:%M:%S)" >> "$LOG"
-exec bash scripts/tpu_session_loop.sh
+# child (not exec): the EXIT trap must release the lock when the loop ends
+bash scripts/tpu_session_loop.sh
+exit $?

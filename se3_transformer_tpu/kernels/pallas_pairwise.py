@@ -177,6 +177,145 @@ def pallas_available() -> bool:
 
 
 # --------------------------------------------------------------------- #
+# basis-fused forward (V2 never touches HBM)
+# --------------------------------------------------------------------- #
+# The plain kernel above takes V2[e, P, IF] = sum_Q B[e,P,Q,F] x[e,c,Q]
+# precomputed by an XLA einsum — which materializes V2 in HBM (write +
+# read of E*P*IF floats, ~4-10x the traffic of B and x themselves at
+# trunk widths). This variant moves that contraction into the kernel:
+# per (e-block, c-chunk) program it reconstructs each V2 row [1, E] from
+# a [Q, E] elementwise product + sublane reduction, so V2 only ever
+# exists rows-at-a-time in VMEM. One kernel per (d_in, d_out) pair
+# (the group concat of conv.py needs a uniform IF chunk axis, which
+# heterogeneous (Q, F) segments don't give).
+#
+# Layouts (edge-on-lanes, as above):
+#   bt [P*F*Q, E]   B rows, (p, f, q) flattened p-major — the (p, f)
+#                   row-pairs the kernel reduces over are contiguous
+#   xt [C*Q, E]     gathered features, (c, q) flattened c-major,
+#                   C padded to a multiple of the c-chunk
+#   w3t [(IF)*O, mid]  (i=(c,f), o) flattened i-major, rows padded with
+#                   zeros for the padded c's (their contributions vanish)
+# Grid (n_e, n_c) with the out block accumulated over the inner c axis.
+
+
+def _fwd_bx_kernel(ht_ref, w3t_ref, bt_ref, xt_ref, o_ref, *,
+                   P, O, Q, F, cb, precision):
+    c0 = pl.program_id(1)
+    rt = jax.lax.dot_general(
+        w3t_ref[:], ht_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32)          # [cb*F*O, E_b]
+    for p in range(P):
+        acc = None
+        for il in range(cb * F):
+            c_l, f_l = divmod(il, F)
+            b_sl = (p * F + f_l) * Q
+            # V2 row for (p, i=(c, f)): one [Q, E] product + reduction
+            v2row = jnp.sum(
+                bt_ref[b_sl:b_sl + Q, :] * xt_ref[c_l * Q:(c_l + 1) * Q, :],
+                axis=0, keepdims=True)               # [1, E_b]
+            term = v2row * rt[il * O:(il + 1) * O, :]
+            acc = term if acc is None else acc + term
+        sl = slice(p * O, (p + 1) * O)
+
+        @pl.when(c0 == 0)
+        def _(acc=acc, sl=sl):
+            o_ref[sl, :] = acc.astype(o_ref.dtype)
+
+        @pl.when(c0 > 0)
+        def _(acc=acc, sl=sl):
+            o_ref[sl, :] = o_ref[sl, :] + acc.astype(o_ref.dtype)
+
+
+def _pick_blocks_bx(E: int, C: int, O: int, P: int, Q: int, F: int,
+                    mid: int, vmem_budget: int = 6 * 2 ** 20,
+                    max_unroll: int = 512):
+    """(block_e, cb) for the basis-fused kernel. cb is the c-chunk: a
+    multiple of 8 (so the xt row-block cb*Q and w3t row-block cb*F*O are
+    tile-aligned for any odd Q/F) or the full (padded) C."""
+    for block_e in (512, 256, 128):
+        if block_e > _round_up(E, 128):
+            continue
+        cb = min(_round_up(C, 8), max(8, max_unroll // max(P * F, 1)
+                                      // 8 * 8))
+        while True:
+            ht = mid * block_e
+            w3 = cb * F * O * mid
+            rt = cb * F * O * block_e
+            bt = P * F * Q * block_e
+            xt = cb * Q * block_e
+            out = P * O * block_e
+            total = 4 * (ht + w3 + 2 * rt + bt + xt + out)
+            if total <= vmem_budget:
+                return block_e, cb
+            if cb <= 8:
+                break
+            cb = max(8, cb // 2 // 8 * 8)
+    return 128, 8
+
+
+@functools.partial(jax.jit, static_argnames=('interpret', 'precision'))
+def fused_pairwise_conv_bx(h: jnp.ndarray, w3: jnp.ndarray,
+                           basis: jnp.ndarray, x: jnp.ndarray,
+                           interpret: bool = False,
+                           precision=None) -> jnp.ndarray:
+    """Basis-fused forward: h [E, mid], w3 [mid, C*F, O] (i=(c,f)
+    c-major), basis [E, P, Q, F], x [E, C, Q] -> out [E, P, O] (f32).
+
+    Equals fused_pairwise_conv(h, w3, einsum('epqf,ecq->e p (c f)', ...))
+    without ever materializing that V2 tensor in HBM. Bias folding is the
+    caller's job, as in fused_pairwise_conv.
+    """
+    E, mid = h.shape
+    _, P, Q, F = basis.shape
+    C = x.shape[1]
+    O = w3.shape[-1]
+    assert w3.shape[1] == C * F, (w3.shape, C, F)
+
+    block_e, cb = _pick_blocks_bx(E, C, O, P, Q, F, mid)
+    Cp = _round_up(C, cb)
+    Ep = _round_up(E, block_e)
+
+    ht = h.T                                          # [mid, E]
+    bt = basis.transpose(1, 3, 2, 0).reshape(P * F * Q, E)
+    xt = x.transpose(1, 2, 0).reshape(C * Q, E)
+    w3t = w3.reshape(mid, C * F * O).T                # [(c,f,o), mid]
+    if Cp != C:
+        xt = jnp.pad(xt, ((0, (Cp - C) * Q), (0, 0)))
+        w3t = jnp.pad(w3t, ((0, (Cp - C) * F * O), (0, 0)))
+    if Ep != E:
+        ht = jnp.pad(ht, ((0, 0), (0, Ep - E)))
+        bt = jnp.pad(bt, ((0, 0), (0, Ep - E)))
+        xt = jnp.pad(xt, ((0, 0), (0, Ep - E)))
+
+    n_e, n_c = Ep // block_e, Cp // cb
+
+    outt = pl.pallas_call(
+        functools.partial(_fwd_bx_kernel, P=P, O=O, Q=Q, F=F, cb=cb,
+                          precision=precision),
+        grid=(n_e, n_c),
+        in_specs=[
+            pl.BlockSpec((mid, block_e), lambda e, c: (0, e),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((cb * F * O, mid), lambda e, c: (c, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((P * F * Q, block_e), lambda e, c: (0, e),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((cb * Q, block_e), lambda e, c: (c, e),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((P * O, block_e), lambda e, c: (0, e),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((P * O, Ep), jnp.float32),
+        interpret=interpret,
+    )(ht, w3t, bt, xt)
+
+    return outt.reshape(P, O, Ep).transpose(2, 0, 1)[:E]
+
+
+# --------------------------------------------------------------------- #
 # fused backward
 # --------------------------------------------------------------------- #
 # Cotangents of out[e,P,o] = sum_{if} V2[e,P,if] (H W3)[e,if,o]:

@@ -110,6 +110,10 @@ class SE3TransformerModule(nn.Module):
     out_fiber_dict: Optional[Dict[int, int]] = None
     # None -> auto (Pallas fused pairwise kernel on TPU, XLA elsewhere)
     pallas: Optional[bool] = None
+    # contract the angular basis inside the pairwise kernel (forward):
+    # the V2 intermediate never touches HBM (kernels.pallas_pairwise bx)
+    fuse_basis: bool = False
+    pallas_interpret: bool = False  # tests: interpreter-mode conv kernel
     # None -> auto: fused per-degree attention kernel on TPU (sim/softmax/
     # weighted-sum in VMEM, one kv pass — kernels.pallas_attention)
     pallas_attention: Optional[bool] = None
@@ -368,7 +372,9 @@ class SE3TransformerModule(nn.Module):
             num_fourier_features=self.rel_dist_num_fourier_features,
             pallas=self.pallas,
             shared_radial_hidden=self.shared_radial_hidden,
-            edge_chunks=self.edge_chunks)
+            edge_chunks=self.edge_chunks,
+            fuse_basis=self.fuse_basis,
+            pallas_interpret=self.pallas_interpret)
 
         # project in + pre-convs (reference :1338-1344)
         with named_scope('conv_in'):
@@ -490,7 +496,8 @@ class SE3TransformerModule(nn.Module):
             pallas_attention=self.pallas_attention,
             pallas_attention_interpret=self.pallas_attention_interpret,
             shared_radial_hidden=self.shared_radial_hidden,
-            edge_chunks=self.edge_chunks, name='trunk')(
+            edge_chunks=self.edge_chunks, fuse_basis=self.fuse_basis,
+            pallas_interpret=self.pallas_interpret, name='trunk')(
                 x, edge_info, rel_dist, basis, global_feats, pos_emb, mask)
 
 

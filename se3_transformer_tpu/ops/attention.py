@@ -48,6 +48,8 @@ class AttentionSE3(nn.Module):
     pallas_attention_interpret: bool = False
     shared_radial_hidden: bool = False
     edge_chunks: Optional[int] = None
+    fuse_basis: bool = False
+    pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -75,7 +77,9 @@ class AttentionSE3(nn.Module):
             num_fourier_features=self.rel_dist_num_fourier_features,
             pallas=self.pallas,
             shared_radial_hidden=self.shared_radial_hidden,
-            edge_chunks=self.edge_chunks)
+            edge_chunks=self.edge_chunks,
+            fuse_basis=self.fuse_basis,
+            pallas_interpret=self.pallas_interpret)
 
         queries = LinearSE3(self.fiber, hidden_fiber, name='to_q')(features)
         values = ConvSE3(self.fiber, kv_fiber, name='to_v', **conv_kwargs)(
@@ -222,6 +226,8 @@ class AttentionBlockSE3(nn.Module):
     pallas_attention_interpret: bool = False
     shared_radial_hidden: bool = False
     edge_chunks: Optional[int] = None
+    fuse_basis: bool = False
+    pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -246,6 +252,8 @@ class AttentionBlockSE3(nn.Module):
             pallas_attention_interpret=self.pallas_attention_interpret,
             shared_radial_hidden=self.shared_radial_hidden,
             edge_chunks=self.edge_chunks,
+            fuse_basis=self.fuse_basis,
+            pallas_interpret=self.pallas_interpret,
             name='attn')(out, edge_info, rel_dist, basis, global_feats,
                          pos_emb, mask)
         return residual_se3(out, res)

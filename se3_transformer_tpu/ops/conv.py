@@ -79,6 +79,32 @@ def radial_hidden(x: jnp.ndarray, mid_dim: int) -> jnp.ndarray:
     return x
 
 
+def _use_pallas(pallas: Optional[bool], interpret: bool) -> bool:
+    """The one dispatch rule for the fused pairwise kernels: explicit
+    setting wins, else auto on TPU; interpreter mode forces the kernel."""
+    if pallas is None:
+        pallas = jax.default_backend() == 'tpu'
+    return pallas or interpret
+
+
+def _stream_node_chunks(contract, operands, edge_chunks: int):
+    """Run contract(*operands) streaming the node axis (axis 1) in
+    `edge_chunks` remat'd chunks via lax.map (the memory ceiling for
+    huge channel counts; peak extra memory is one chunk's working set)."""
+    n = operands[0].shape[1]
+    c = edge_chunks
+    assert n % c == 0, f'nodes {n} must divide into {c} edge_chunks'
+
+    def split(a):
+        a = a.reshape(a.shape[0], c, n // c, *a.shape[2:])
+        return jnp.swapaxes(a, 0, 1)
+
+    out = jax.lax.map(jax.checkpoint(lambda t: contract(*t)),
+                      tuple(split(a) for a in operands))
+    out = jnp.swapaxes(out, 0, 1)
+    return out.reshape(out.shape[0], n, *out.shape[3:])
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _pairwise_contract_pallas(h, w3b, v2, interpret=False, precision=None):
     from ..kernels.pallas_pairwise import fused_pairwise_conv
@@ -105,6 +131,44 @@ def _pc_bwd(interpret, precision, res, g):
 _pairwise_contract_pallas.defvjp(_pc_fwd, _pc_bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _pairwise_contract_pallas_bx(h, w3b, basis, x, interpret=False,
+                                 precision=None):
+    from ..kernels.pallas_pairwise import fused_pairwise_conv_bx
+    return fused_pairwise_conv_bx(h, w3b, basis, x, interpret=interpret,
+                                  precision=precision)
+
+
+def _pc_bx_fwd(h, w3b, basis, x, interpret=False, precision=None):
+    return (_pairwise_contract_pallas_bx(h, w3b, basis, x, interpret,
+                                         precision),
+            (h, w3b, basis, x))
+
+
+def _pc_bx_bwd(interpret, precision, res, g):
+    # V2 materializes only here, in the backward; the forward never wrote
+    # it to HBM. Reuses the fused backward kernel, then folds its dV2
+    # cotangent back through the basis contraction (dbasis feeds
+    # coordinate gradients when differentiable_coors is on).
+    from ..kernels.pallas_pairwise import fused_pairwise_conv_bwd
+    h, w3b, basis, x = res
+    E, P, Q, F = basis.shape
+    C = x.shape[1]
+    v2 = jnp.einsum('epqf,ecq->epcf', basis, x,
+                    precision=precision).reshape(E, P, C * F)
+    dh, dw3, dv2 = fused_pairwise_conv_bwd(h, w3b, v2, g,
+                                           interpret=interpret,
+                                           precision=precision)
+    dv2 = dv2.reshape(E, P, C, F)
+    dx = jnp.einsum('epqf,epcf->ecq', basis, dv2, precision=precision)
+    dbasis = jnp.einsum('ecq,epcf->epqf', x, dv2, precision=precision)
+    return (dh.astype(h.dtype), dw3.astype(w3b.dtype),
+            dbasis.astype(basis.dtype), dx.astype(x.dtype))
+
+
+_pairwise_contract_pallas_bx.defvjp(_pc_bx_fwd, _pc_bx_bwd)
+
+
 class PairwiseConvSE3(nn.Module):
     """Single (d_in -> d_out) pairwise kernel + contraction
     (reference PairwiseConv :301-343, fused).
@@ -124,6 +188,10 @@ class PairwiseConvSE3(nn.Module):
     # (lax.map + remat): bounds peak memory to O(E/edge_chunks * c_in *
     # c_out * F) for huge configs (e.g. dim-512 flagship). None = off.
     edge_chunks: Optional[int] = None
+    # contract the angular basis inside the Pallas kernel so the V2
+    # intermediate never touches HBM (forward only; the backward
+    # materializes it once). Requires the Pallas path; ignored otherwise.
+    fuse_basis: bool = False
     # False = reference-ordered unfused path through RadialFunc (per-edge
     # [c_out, c_in, F] kernel tensors, reference :326-343); the numerics
     # oracle for the fused paths above. Param layout differs.
@@ -155,6 +223,14 @@ class PairwiseConvSE3(nn.Module):
         b3 = self.param('b3', nn.initializers.zeros, (IF, self.nc_out),
                         h.dtype)
 
+        if self.fuse_basis and _use_pallas(self.pallas,
+                                          self.pallas_interpret):
+            out = _radial_contract_bx(
+                h, w3, b3, basis_slice, x,
+                pallas_interpret=self.pallas_interpret,
+                edge_chunks=self.edge_chunks)
+            return jnp.swapaxes(out, -1, -2)  # [..., c_out, P]
+
         # V2[..., P, (i, f)] = sum_Q B[..., P, Q, f] x[..., i, Q]
         v2 = jnp.einsum('...pqf,...cq->...pcf', basis_slice, x)
         v2 = v2.reshape(*v2.shape[:-2], IF)  # [..., P, c_in*F]
@@ -177,14 +253,8 @@ def _radial_contract(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
     R — XLA path — or just the kernel's VMEM tiles — Pallas path)."""
     P, IF = v2.shape[-2], v2.shape[-1]
     O = w3.shape[-1]
-    lead = h.shape[:-1]
 
-    use_pallas = pallas
-    if use_pallas is None:
-        use_pallas = jax.default_backend() == 'tpu'
-    use_pallas = use_pallas or pallas_interpret
-
-    if use_pallas:
+    if _use_pallas(pallas, pallas_interpret):
         # fold bias once: ones column on h (appended per chunk), bias row
         # on w3. Capture the active matmul-precision policy at trace time:
         # the custom_vjp backward traces outside the model's
@@ -209,15 +279,39 @@ def _radial_contract(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
 
     if edge_chunks is None:
         return contract(h, v2)
+    return _stream_node_chunks(contract, (h, v2), edge_chunks)
 
-    n = h.shape[1]
-    c = edge_chunks
-    assert n % c == 0, f'nodes {n} must divide into {c} edge_chunks'
-    h_s = h.reshape(h.shape[0], c, n // c, *h.shape[2:])
-    v2_s = v2.reshape(v2.shape[0], c, n // c, *v2.shape[2:])
-    h_s, v2_s = jnp.swapaxes(h_s, 0, 1), jnp.swapaxes(v2_s, 0, 1)
-    out = jax.lax.map(jax.checkpoint(lambda a: contract(*a)), (h_s, v2_s))
-    return jnp.swapaxes(out, 0, 1).reshape(*lead, P, O)
+
+def _radial_contract_bx(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
+                        basis: jnp.ndarray, x: jnp.ndarray, *,
+                        pallas_interpret: bool,
+                        edge_chunks: Optional[int]) -> jnp.ndarray:
+    """Basis-fused dispatch (Pallas only): h [b,n,k,mid], w3 [mid,C*F,O],
+    b3 [C*F,O], basis [b,n,k,P,Q,F], x [b,n,k,C,Q] -> [b,n,k,P,O].
+    Same contraction as _radial_contract on V2 = basis . x, but V2 never
+    exists outside kernel VMEM (see kernels.pallas_pairwise, bx
+    variant)."""
+    P, Q, F = basis.shape[-3:]
+    C = x.shape[-2]
+    O = w3.shape[-1]
+    w3b = jnp.concatenate([w3, b3[None]], axis=0)
+    prec = jax.config.jax_default_matmul_precision
+
+    def contract(h_c, basis_c, x_c):
+        lead_c = h_c.shape[:-1]
+        E = 1
+        for s in lead_c:
+            E *= s
+        h2 = h_c.reshape(E, h_c.shape[-1])
+        h2 = jnp.concatenate([h2, jnp.ones((E, 1), h2.dtype)], axis=-1)
+        out = _pairwise_contract_pallas_bx(
+            h2, w3b, basis_c.reshape(E, P, Q, F), x_c.reshape(E, C, Q),
+            pallas_interpret, prec)
+        return out.reshape(*lead_c, P, O)
+
+    if edge_chunks is None:
+        return contract(h, basis, x)
+    return _stream_node_chunks(contract, (h, basis, x), edge_chunks)
 
 
 def pairwise_conv_contract(R: jnp.ndarray, B: jnp.ndarray,
@@ -246,6 +340,7 @@ class ConvSE3(nn.Module):
     # the reference uses an independent MLP per pair, which dominates FLOPs
     # at small channel counts — parameterization differs when enabled)
     shared_radial_hidden: bool = False
+    fuse_basis: bool = False
 
     @nn.compact
     def __call__(self, inp: Features, edge_info: EdgeInfo,
@@ -272,6 +367,9 @@ class ConvSE3(nn.Module):
         hidden = radial_hidden(edge_features, DEFAULT_MID_DIM) \
             if self.shared_radial_hidden else None
 
+        fuse_bx = self.fuse_basis and _use_pallas(self.pallas,
+                                                  self.pallas_interpret)
+
         outputs = {}
         for degree_out, m_out in self.fiber_out:
             if self.shared_radial_hidden:
@@ -279,31 +377,46 @@ class ConvSE3(nn.Module):
                 # only in (w3, b3, v2), all concatenable along the
                 # contracted IF axis: ONE fused contraction (one Pallas
                 # launch / one big MXU matmul) per output degree instead of
-                # one per degree pair
+                # one per degree pair. With fuse_basis the heterogeneous
+                # (Q, F) segments can't share a chunk axis, so it's one
+                # basis-fused launch per pair instead (same params).
                 v2s, w3s, b3s = [], [], []
+                acc = None
                 for degree_in, m_in in self.fiber_in:
                     F = to_order(min(degree_in, degree_out))
                     IF = m_in * F
-                    v2 = jnp.einsum('...pqf,...cq->...pcf',
-                                    basis[f'{degree_in},{degree_out}'],
-                                    gathered[str(degree_in)])
-                    v2s.append(v2.reshape(*v2.shape[:-2], IF))
-                    w3s.append(self.param(
+                    w3 = self.param(
                         f'w3_{degree_in}_{degree_out}',
                         nn.initializers.variance_scaling(
                             1.0, 'fan_in', 'truncated_normal',
                             in_axis=0, out_axis=(1, 2)),
-                        (hidden.shape[-1], IF, m_out), hidden.dtype))
-                    b3s.append(self.param(
+                        (hidden.shape[-1], IF, m_out), hidden.dtype)
+                    b3 = self.param(
                         f'b3_{degree_in}_{degree_out}',
-                        nn.initializers.zeros, (IF, m_out), hidden.dtype))
-                acc = _radial_contract(
-                    hidden, jnp.concatenate(w3s, axis=1),
-                    jnp.concatenate(b3s, axis=0),
-                    jnp.concatenate(v2s, axis=-1),
-                    pallas=self.pallas,
-                    pallas_interpret=self.pallas_interpret,
-                    edge_chunks=self.edge_chunks)
+                        nn.initializers.zeros, (IF, m_out), hidden.dtype)
+                    if fuse_bx:
+                        y = _radial_contract_bx(
+                            hidden, w3, b3,
+                            basis[f'{degree_in},{degree_out}'],
+                            gathered[str(degree_in)],
+                            pallas_interpret=self.pallas_interpret,
+                            edge_chunks=self.edge_chunks)
+                        acc = y if acc is None else acc + y
+                        continue
+                    v2 = jnp.einsum('...pqf,...cq->...pcf',
+                                    basis[f'{degree_in},{degree_out}'],
+                                    gathered[str(degree_in)])
+                    v2s.append(v2.reshape(*v2.shape[:-2], IF))
+                    w3s.append(w3)
+                    b3s.append(b3)
+                if not fuse_bx:
+                    acc = _radial_contract(
+                        hidden, jnp.concatenate(w3s, axis=1),
+                        jnp.concatenate(b3s, axis=0),
+                        jnp.concatenate(v2s, axis=-1),
+                        pallas=self.pallas,
+                        pallas_interpret=self.pallas_interpret,
+                        edge_chunks=self.edge_chunks)
                 acc = jnp.swapaxes(acc, -1, -2)  # [..., c_out, P]
             else:
                 acc = None
@@ -313,6 +426,7 @@ class ConvSE3(nn.Module):
                         pallas=self.pallas,
                         pallas_interpret=self.pallas_interpret,
                         edge_chunks=self.edge_chunks,
+                        fuse_basis=self.fuse_basis,
                         name=f'pair_{degree_in}_{degree_out}')(
                             edge_features,
                             basis[f'{degree_in},{degree_out}'],

@@ -52,6 +52,8 @@ class SequentialTrunk(nn.Module):
     pallas_attention_interpret: bool = False
     shared_radial_hidden: bool = False
     edge_chunks: Optional[int] = None
+    fuse_basis: bool = False
+    pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, x: Features, edge_info, rel_dist, basis,
@@ -78,6 +80,8 @@ class SequentialTrunk(nn.Module):
                 pallas_attention_interpret=self.pallas_attention_interpret,
                 shared_radial_hidden=self.shared_radial_hidden,
                 edge_chunks=self.edge_chunks,
+                fuse_basis=self.fuse_basis,
+                pallas_interpret=self.pallas_interpret,
                 name=f'attn_block{i}')(
                     x, edge_info, rel_dist, basis, global_feats, pos_emb,
                     mask)

@@ -345,3 +345,138 @@ def test_shared_radial_group_path():
             < 1e-4
         assert np.abs(np.asarray(out_i[str(d_out)])
                       - np.asarray(out[str(d_out)])).max() < 1e-4
+
+
+# ------------------------------------------------------------------ #
+# basis-fused pairwise kernel (V2 in VMEM only)
+# ------------------------------------------------------------------ #
+
+@pytest.mark.parametrize('shape', [
+    # (E, mid, C, Q, F, O, P) — incl. C not a multiple of the c-chunk,
+    # E off the block grid, and the degree-0 singleton axes
+    (37, 16, 4, 3, 3, 5, 7),
+    (130, 8, 9, 5, 3, 4, 5),
+    (8, 8, 1, 1, 1, 3, 1),
+    (257, 24, 16, 7, 7, 8, 7),
+])
+def test_fused_bx_kernel_matches_einsum(shape):
+    from se3_transformer_tpu.kernels.pallas_pairwise import (
+        fused_pairwise_conv_bx,
+    )
+    E, mid, C, Q, F, O, P = shape
+    rng = np.random.RandomState(sum(shape))
+    h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
+    w3 = jnp.asarray(rng.normal(size=(mid, C * F, O)), jnp.float32)
+    basis = jnp.asarray(rng.normal(size=(E, P, Q, F)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(E, C, Q)), jnp.float32)
+
+    out = fused_pairwise_conv_bx(h, w3, basis, x, interpret=True)
+    v2 = jnp.einsum('epqf,ecq->epcf', basis, x).reshape(E, P, C * F)
+    ref = jnp.einsum('epk,eko->epo', v2, jnp.einsum('em,mko->eko', h, w3))
+    scale = float(jnp.abs(ref).max()) + 1e-9
+    assert jnp.abs(out - ref).max() / scale < 1e-5, shape
+
+
+@pytest.mark.parametrize('d_in,d_out', [(0, 1), (1, 1), (2, 1), (1, 2)])
+def test_pairwise_conv_fuse_basis_matches_xla(d_in, d_out):
+    """Module level: fuse_basis forward and ALL gradients (params, x, and
+    the basis itself — the differentiable-coors path) match the XLA
+    path."""
+    rng = np.random.RandomState(11)
+    b, n, k, ci, co = 1, 6, 3, 4, 5
+    edge = jnp.asarray(rng.normal(size=(b, n, k, 2)), jnp.float32)
+    rel = jnp.asarray(rng.normal(size=(b, n, k, 3)), jnp.float32)
+    basis = get_basis(rel, max(d_in, d_out))[f'{d_in},{d_out}']
+    x = jnp.asarray(rng.normal(size=(b, n, k, ci, 2 * d_in + 1)), jnp.float32)
+
+    xla_mod = PairwiseConvSE3(d_in, ci, d_out, co, pallas=False)
+    params = xla_mod.init(jax.random.PRNGKey(0), edge, basis, x)
+    bx_mod = PairwiseConvSE3(d_in, ci, d_out, co, pallas=False,
+                             pallas_interpret=True, fuse_basis=True)
+
+    out_ref = xla_mod.apply(params, edge, basis, x)
+    out_bx = bx_mod.apply(params, edge, basis, x)
+    assert out_bx.shape == out_ref.shape
+    assert jnp.abs(out_bx - out_ref).max() < 1e-4
+
+    def loss(mod):
+        return lambda p, bb, xx: (mod.apply(p, edge, bb, xx) ** 2).sum()
+
+    g1 = jax.grad(loss(xla_mod), argnums=(0, 1, 2))(params, basis, x)
+    g2 = jax.grad(loss(bx_mod), argnums=(0, 1, 2))(params, basis, x)
+    for a, b2 in zip(jax.tree_util.tree_leaves(g1),
+                     jax.tree_util.tree_leaves(g2)):
+        s = float(jnp.abs(a).max()) + 1e-9
+        assert jnp.abs(a - b2).max() / s < 1e-4, (d_in, d_out)
+
+
+def test_convse3_fuse_basis_group_path():
+    """ConvSE3(shared_radial_hidden=True, fuse_basis=True) — one
+    basis-fused launch per pair over the SAME param tree as the group
+    concat path — matches it in values and parameter gradients."""
+    from se3_transformer_tpu.ops import ConvSE3, Fiber
+    from se3_transformer_tpu.utils import batched_index_select
+
+    rng = np.random.RandomState(13)
+    n, k, dim, degrees = 12, 4, 6, 3
+    fiber = Fiber.create(degrees, dim)
+    feats = {str(d): jnp.asarray(rng.normal(size=(1, n, dim, 2 * d + 1)),
+                                 jnp.float32) for d in range(degrees)}
+    coors = jnp.asarray(rng.normal(size=(1, n, 3)) * 2, jnp.float32)
+    idx = jnp.asarray(rng.randint(0, n, (1, n, k)), jnp.int32)
+    mask = jnp.ones((1, n, k), bool)
+    coors_j = batched_index_select(coors, idx, axis=1)
+    rel = coors[:, :, None, :] - coors_j
+    rd = jnp.linalg.norm(rel, axis=-1)
+    basis = get_basis(rel, degrees - 1)
+    args = (feats, (idx, mask, None), rd, basis)
+
+    group = ConvSE3(fiber, fiber, shared_radial_hidden=True, pallas=False,
+                    pool=False, self_interaction=False)
+    params = group.init(jax.random.PRNGKey(0), *args)
+    bx = ConvSE3(fiber, fiber, shared_radial_hidden=True, pallas=False,
+                 pallas_interpret=True, fuse_basis=True,
+                 pool=False, self_interaction=False)
+
+    out_g = group.apply(params, *args)
+    out_b = bx.apply(params, *args)
+    for d in out_g:
+        assert np.abs(np.asarray(out_g[d]) - np.asarray(out_b[d])).max() \
+            < 1e-4, d
+
+    def loss(mod):
+        return lambda p: sum((mod.apply(p, *args)[d] ** 2).sum()
+                             for d in map(str, range(degrees)))
+
+    g1 = jax.grad(loss(group))(params)
+    g2 = jax.grad(loss(bx))(params)
+    for a, b2 in zip(jax.tree_util.tree_leaves(g1),
+                     jax.tree_util.tree_leaves(g2)):
+        s = float(jnp.abs(a).max()) + 1e-9
+        assert jnp.abs(a - b2).max() / s < 1e-4
+
+
+def test_model_fuse_basis_matches_base():
+    """Full model wiring: fuse_basis=True (interpreter kernels) output
+    identical to the plain path, shared and unshared radial trunks."""
+    from se3_transformer_tpu import SE3TransformerModule
+
+    rng = np.random.RandomState(5)
+    feats = jnp.asarray(rng.normal(size=(1, 16, 8)), jnp.float32)
+    coors = jnp.asarray(rng.normal(size=(1, 16, 3)), jnp.float32)
+    mask = jnp.ones((1, 16), bool)
+
+    for shared in (False, True):
+        base = dict(dim=8, depth=1, attend_self=True, num_neighbors=5,
+                    num_degrees=3, output_degrees=2, heads=2, dim_head=4,
+                    shared_radial_hidden=shared)
+        plain = SE3TransformerModule(**base, pallas=False)
+        fused = SE3TransformerModule(**base, pallas=False,
+                                     pallas_interpret=True, fuse_basis=True)
+        params = plain.init(jax.random.PRNGKey(0), feats, coors, mask=mask,
+                            return_type=1)['params']
+        o1 = plain.apply({'params': params}, feats, coors, mask=mask,
+                         return_type=1)
+        o2 = fused.apply({'params': params}, feats, coors, mask=mask,
+                         return_type=1)
+        assert np.abs(np.asarray(o1) - np.asarray(o2)).max() < 2e-5, shared

@@ -23,12 +23,13 @@ import numpy as np
 from se3_transformer_tpu.models.se3_transformer import SE3TransformerModule
 
 
-def check_equivariance(precision: str):
+def check_equivariance(precision: str, radial_bf16: bool = False):
     from se3_transformer_tpu.utils.validation import equivariance_l2
 
     module = SE3TransformerModule(
         dim=16, depth=1, attend_self=True, num_neighbors=8, num_degrees=3,
-        output_degrees=2, fourier_encode_dist=True)
+        output_degrees=2, fourier_encode_dist=True,
+        radial_bf16=radial_bf16)
     rng = np.random.RandomState(0)
     feats = jnp.asarray(rng.normal(size=(1, 32, 16)), jnp.float32)
     coors = jnp.asarray(rng.normal(size=(1, 32, 3)), jnp.float32)
@@ -74,7 +75,7 @@ def check_equivariance_sparse_only(precision: str = 'float32'):
 
 
 def bench_conv(pallas: bool, n=512, k=24, dim=32, degrees=3, iters=10,
-               fuse_basis=False):
+               fuse_basis=False, radial_bf16=False):
     from se3_transformer_tpu.basis import get_basis
     from se3_transformer_tpu.ops import ConvSE3, Fiber
     from se3_transformer_tpu.utils import batched_index_select
@@ -87,7 +88,8 @@ def bench_conv(pallas: bool, n=512, k=24, dim=32, degrees=3, iters=10,
     idx = jnp.asarray(rng.randint(0, n, (1, n, k)), jnp.int32)
     mask = jnp.ones((1, n, k), bool)
 
-    conv = ConvSE3(fiber, fiber, pallas=pallas, fuse_basis=fuse_basis)
+    conv = ConvSE3(fiber, fiber, pallas=pallas, fuse_basis=fuse_basis,
+                   radial_bf16=radial_bf16)
 
     # jit the input prep: eager gathers/basis would round-trip thousands of
     # tiny ops through the device tunnel (minutes of latency)
@@ -207,6 +209,10 @@ def main():
         print(f'equivariance @ matmul_precision={prec}: abs={err:.2e} '
               f'rel={rel:.2e} [{status if prec == "float32" else "info"}]')
 
+    err_rb, rel_rb = check_equivariance('float32', radial_bf16=True)
+    print(f'equivariance @ f32 + radial_bf16: abs={err_rb:.2e} '
+          f'rel={rel_rb:.2e} [{"PASS" if err_rb < 1e-4 else "FAIL"}]')
+
     err_sp = check_equivariance_sparse_only()
     print(f'equivariance sparse-only @ f32: abs={err_sp:.2e} '
           f'[{"PASS" if err_sp < 1e-4 else "FAIL"}]')
@@ -229,6 +235,15 @@ def main():
     print(f'ConvSE3 fwd fuse_basis: {t_bx*1e3:.1f} ms '
           f'({t_xla/t_bx:.2f}x vs xla, {t_pl/t_bx:.2f}x vs pallas), '
           f'max|diff|={diff:.2e} [{"PASS" if diff < 1e-3 else "FAIL"}]')
+
+    t_rb, out_rb = bench_conv(pallas=True, fuse_basis=True,
+                              radial_bf16=True)
+    scale = max(float(jnp.abs(out_xla[d]).max()) for d in out_xla)
+    diff = max(float(jnp.abs(out_xla[d] - out_rb[d]).max())
+               for d in out_xla) / scale
+    print(f'ConvSE3 fwd fuse_basis+radial_bf16: {t_rb*1e3:.1f} ms '
+          f'({t_xla/t_rb:.2f}x vs xla), rel diff={diff:.2e} '
+          f'[{"PASS" if diff < 3e-2 else "FAIL"}]')
 
     t_ax, out_ax = bench_attention(fused=False)
     t_af, out_af = bench_attention(fused=True)

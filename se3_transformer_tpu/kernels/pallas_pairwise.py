@@ -138,6 +138,14 @@ def fused_pairwise_conv(h: jnp.ndarray, w3: jnp.ndarray, v2: jnp.ndarray,
     _, IF, O = w3.shape
     P = v2.shape[1]
 
+    # bf16 radial operands (radial_bf16): run the rt dot MXU-native with
+    # f32 accumulation — an explicit precision would upcast and defeat it
+    if h.dtype == jnp.bfloat16:
+        precision = None
+        if interpret:  # CPU interpret can't dispatch BF16xBF16=F32 dots;
+            # the upcast is exact and accumulation is f32 either way
+            h, w3 = h.astype(jnp.float32), w3.astype(jnp.float32)
+
     block_e, block_if = _pick_blocks(E, IF, O, P, mid)
     Ep, IFp = _round_up(E, block_e), _round_up(IF, block_if)
 
@@ -273,6 +281,10 @@ def fused_pairwise_conv_bx(h: jnp.ndarray, w3: jnp.ndarray,
     C = x.shape[1]
     O = w3.shape[-1]
     assert w3.shape[1] == C * F, (w3.shape, C, F)
+    if h.dtype == jnp.bfloat16:  # see fused_pairwise_conv
+        precision = None
+        if interpret:
+            h, w3 = h.astype(jnp.float32), w3.astype(jnp.float32)
 
     block_e, cb = _pick_blocks_bx(E, C, O, P, Q, F, mid)
     Cp = _round_up(C, cb)
@@ -402,7 +414,10 @@ def fused_pairwise_conv_bwd(h: jnp.ndarray, w3: jnp.ndarray,
     """Backward of fused_pairwise_conv: returns (dh, dw3, dv2), all f32.
 
     h [E, mid], w3 [mid, IF, O], v2 [E, P, IF], g [E, P, O].
+    bf16 radial operands are upcast (exactly) and the backward runs in
+    f32 — gradients stay at the policy precision under radial_bf16.
     """
+    h, w3 = h.astype(jnp.float32), w3.astype(jnp.float32)
     E, mid = h.shape
     _, IF, O = w3.shape
     P = v2.shape[1]

@@ -113,6 +113,9 @@ class SE3TransformerModule(nn.Module):
     # contract the angular basis inside the pairwise kernel (forward):
     # the V2 intermediate never touches HBM (kernels.pallas_pairwise bx)
     fuse_basis: bool = False
+    # bf16 radial trunk/matmul (rotation-invariant inputs: preserves
+    # equivariance, MXU-native speed — see ops.conv.radial_hidden)
+    radial_bf16: bool = False
     pallas_interpret: bool = False  # tests: interpreter-mode conv kernel
     # None -> auto: fused per-degree attention kernel on TPU (sim/softmax/
     # weighted-sum in VMEM, one kv pass — kernels.pallas_attention)
@@ -374,6 +377,7 @@ class SE3TransformerModule(nn.Module):
             shared_radial_hidden=self.shared_radial_hidden,
             edge_chunks=self.edge_chunks,
             fuse_basis=self.fuse_basis,
+            radial_bf16=self.radial_bf16,
             pallas_interpret=self.pallas_interpret)
 
         # project in + pre-convs (reference :1338-1344)
@@ -497,6 +501,7 @@ class SE3TransformerModule(nn.Module):
             pallas_attention_interpret=self.pallas_attention_interpret,
             shared_radial_hidden=self.shared_radial_hidden,
             edge_chunks=self.edge_chunks, fuse_basis=self.fuse_basis,
+            radial_bf16=self.radial_bf16,
             pallas_interpret=self.pallas_interpret, name='trunk')(
                 x, edge_info, rel_dist, basis, global_feats, pos_emb, mask)
 

@@ -50,6 +50,7 @@ class AttentionSE3(nn.Module):
     edge_chunks: Optional[int] = None
     fuse_basis: bool = False
     pallas_interpret: bool = False
+    radial_bf16: bool = False
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -79,6 +80,7 @@ class AttentionSE3(nn.Module):
             shared_radial_hidden=self.shared_radial_hidden,
             edge_chunks=self.edge_chunks,
             fuse_basis=self.fuse_basis,
+            radial_bf16=self.radial_bf16,
             pallas_interpret=self.pallas_interpret)
 
         queries = LinearSE3(self.fiber, hidden_fiber, name='to_q')(features)
@@ -228,6 +230,7 @@ class AttentionBlockSE3(nn.Module):
     edge_chunks: Optional[int] = None
     fuse_basis: bool = False
     pallas_interpret: bool = False
+    radial_bf16: bool = False
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -253,6 +256,7 @@ class AttentionBlockSE3(nn.Module):
             shared_radial_hidden=self.shared_radial_hidden,
             edge_chunks=self.edge_chunks,
             fuse_basis=self.fuse_basis,
+            radial_bf16=self.radial_bf16,
             pallas_interpret=self.pallas_interpret,
             name='attn')(out, edge_info, rel_dist, basis, global_feats,
                          pos_emb, mask)

@@ -54,6 +54,7 @@ class SequentialTrunk(nn.Module):
     edge_chunks: Optional[int] = None
     fuse_basis: bool = False
     pallas_interpret: bool = False
+    radial_bf16: bool = False
 
     @nn.compact
     def __call__(self, x: Features, edge_info, rel_dist, basis,
@@ -81,6 +82,7 @@ class SequentialTrunk(nn.Module):
                 shared_radial_hidden=self.shared_radial_hidden,
                 edge_chunks=self.edge_chunks,
                 fuse_basis=self.fuse_basis,
+                radial_bf16=self.radial_bf16,
                 pallas_interpret=self.pallas_interpret,
                 name=f'attn_block{i}')(
                     x, edge_info, rel_dist, basis, global_feats, pos_emb,

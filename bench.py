@@ -52,8 +52,19 @@ def _device_backend_or_cpu(timeout_s: int = 120) -> str:
     return backend if backend in ('tpu',) else 'cpu'
 
 
-def main(backend: str):
+def main(backend: str, fast: bool = None):
+    """fast=True enables the validated perf knobs (shared radial trunk,
+    basis-fused Pallas kernel, bf16 radial) — same model family, same
+    training task; the equivariance_l2 field in the record keeps the
+    accuracy story honest. Default: SE3_TPU_BENCH_FAST env var, else
+    False (the conservative path the driver records)."""
+    import os
+
     import jax
+
+    if fast is None:
+        fast = os.environ.get('SE3_TPU_BENCH_FAST', '').lower() \
+            in ('1', 'true', 'yes', 'on')
 
     if backend != 'tpu':
         # NOTE: setting the JAX_PLATFORMS env var here is too late — the
@@ -80,11 +91,13 @@ def main(backend: str):
         # bench still completes and is honestly labelled backend=cpu
         num_nodes, num_degrees, batch, num_neighbors, steps = 128, 2, 1, 8, 3
 
+    perf = dict(shared_radial_hidden=True, fuse_basis=True,
+                radial_bf16=True) if fast else dict()
     module = SE3TransformerModule(
         num_tokens=24, dim=8, dim_head=8, heads=2, depth=2,
         attend_self=True, input_degrees=1, num_degrees=num_degrees,
         output_degrees=2, reduce_dim_out=True, differentiable_coors=True,
-        num_neighbors=num_neighbors)
+        num_neighbors=num_neighbors, **perf)
 
     rng = np.random.RandomState(0)
     seqs = jnp.asarray(rng.randint(0, 24, (batch, num_nodes)))
@@ -149,13 +162,15 @@ def main(backend: str):
     eq_err = equivariance_l2(module, params, seqs, coords, masks)
 
     actual = jax.default_backend()
-    # RECORD is a TPU flagship-config number; a CPU fallback run measures a
-    # different workload, so comparing would fabricate a regression
-    vs = nodes_steps_per_sec / RECORD if (RECORD and actual == 'tpu') else 1.0
+    # RECORD is a TPU flagship-config number on the conservative path; a
+    # CPU fallback run OR a fast-mode run measures a different workload,
+    # so comparing would fabricate a regression/speedup
+    vs = nodes_steps_per_sec / RECORD \
+        if (RECORD and actual == 'tpu' and not fast) else 1.0
     record = {
         'metric': f'denoise_train_nodes_steps_per_sec_per_chip'
                   f'(n={num_nodes},deg={num_degrees},k={num_neighbors},'
-                  f'backend={actual})',
+                  f'backend={actual}{",fast" if fast else ""})',
         'value': round(nodes_steps_per_sec, 2),
         'unit': f'nodes*steps/sec/{"chip" if actual == "tpu" else "cpu-host"}',
         'vs_baseline': round(vs, 3),

@@ -78,11 +78,19 @@ def main():
     log('--- flagship bench ---')
     try:
         import bench
-        rec = bench.main('tpu')
+        rec = bench.main('tpu', fast=False)
         log(f'bench: {rec}')
     except Exception:
         failed = True
         log('bench FAILED:\n' + traceback.format_exc())
+
+    log('--- flagship bench (fast: shared radial + fuse_basis + bf16) ---')
+    try:
+        rec = bench.main('tpu', fast=True)
+        log(f'bench fast: {rec}')
+    except Exception:
+        failed = True
+        log('bench fast FAILED:\n' + traceback.format_exc())
 
     log('--- tpu_checks ---')
     try:

@@ -123,17 +123,7 @@ def _to_lanes(h, w3, v2, g=None):
     return ht, w3t, v2t, gt
 
 
-@functools.partial(jax.jit, static_argnames=('interpret', 'precision'))
-def fused_pairwise_conv(h: jnp.ndarray, w3: jnp.ndarray, v2: jnp.ndarray,
-                        interpret: bool = False,
-                        precision=None) -> jnp.ndarray:
-    """h [E, mid], w3 [mid, IF, O], v2 [E, P, IF] -> out [E, P, O] (f32).
-
-    Fold the radial bias by appending a ones column to h and the bias row
-    to w3 before calling (see PairwiseConvSE3). `precision` feeds the
-    in-kernel MXU dots (captured from jax.default_matmul_precision by the
-    caller — the kernel body traces outside that context).
-    """
+def _fused_pairwise_conv_impl(h, w3, v2, interpret, precision):
     E, mid = h.shape
     _, IF, O = w3.shape
     P = v2.shape[1]
@@ -178,6 +168,113 @@ def fused_pairwise_conv(h: jnp.ndarray, w3: jnp.ndarray, v2: jnp.ndarray,
     )(ht, w3t, v2t)
 
     return outt.reshape(P, O, Ep).transpose(2, 0, 1)[:E]
+
+
+# --------------------------------------------------------------------- #
+# SPMD partitioning rules
+# --------------------------------------------------------------------- #
+# The kernels are embarrassingly parallel over the edge axis (e) and the
+# output-channel axis (o); only mid (m) and the contracted IF axis (k)
+# must be replicated. Without these rules GSPMD treats the Mosaic custom
+# call as opaque and would all-gather the sharded edge tensors onto every
+# device. With them, a dp/sp-sharded model runs each device's kernel on
+# its local edges, and tp-sharded radial weights (param_partition_specs
+# shards w3 on o) keep the conv output o-sharded. The backward psums dW3
+# over the edge-sharded axes and dH/dV2 over o-sharded axes inside the
+# partition body — Shardy sees the results as fully reduced.
+
+
+def _spec_axes(sharding, dim):
+    spec = sharding.spec
+    return spec[dim] if len(spec) > dim else None
+
+
+def _axis_tuple(axes):
+    if axes is None:
+        return ()
+    return axes if isinstance(axes, tuple) else (axes,)
+
+
+def _edge_o_axes(arg_shapes):
+    """Resolve the (edge, output-channel) sharding axes from the operand
+    shardings: e from h's dim 0, o from w3's dim 2 (all entry points take
+    (h, w3, ...)). A mesh axis can't shard both — on collision the edge
+    sharding wins and w3/g get resharded by the partitioner."""
+    e = _spec_axes(arg_shapes[0].sharding, 0)
+    o = _spec_axes(arg_shapes[1].sharding, 2)
+    if set(_axis_tuple(e)) & set(_axis_tuple(o)):
+        o = None
+    return e, o
+
+
+def _make_partitioned(impl, rule, need_repl, arg_specs, result_specs,
+                      psum_fn=None):
+    """Build a custom_partitioning wrapper around `impl`.
+
+    arg_specs/result_specs: callables (P_, e, o) -> tuple of
+    PartitionSpec (one per operand / result; a single-result entry point
+    passes a 1-tuple and unwraps). psum_fn(outs, e, o): reduce partial
+    sums inside the partition body (backward only)."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P_
+
+    single = psum_fn is None and len(result_specs(P_, None, None)) == 1
+
+    @custom_partitioning
+    def f(*args):
+        return impl(*args)
+
+    def _shardings(mesh, specs):
+        return tuple(NamedSharding(mesh, s) for s in specs)
+
+    def partition(mesh, arg_shapes, result_shape):
+        e, o = _edge_o_axes(arg_shapes)
+        arg_sh = _shardings(mesh, arg_specs(P_, e, o))
+        res_sh = _shardings(mesh, result_specs(P_, e, o))
+
+        def lower_fn(*args):
+            outs = impl(*args)
+            return psum_fn(outs, e, o) if psum_fn else outs
+
+        return (mesh, lower_fn, res_sh[0] if single else res_sh, arg_sh)
+
+    def infer(mesh, arg_shapes, shape):
+        e, o = _edge_o_axes(arg_shapes)
+        m = arg_shapes[0].sharding.mesh
+        res = _shardings(m, result_specs(P_, e, o))
+        return res[0] if single else res
+
+    f.def_partition(partition=partition,
+                    infer_sharding_from_operands=infer,
+                    sharding_rule=rule,
+                    need_replication_factors=need_repl)
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _fwd_partitioned(interpret, precision):
+    return _make_partitioned(
+        lambda h, w3, v2: _fused_pairwise_conv_impl(h, w3, v2, interpret,
+                                                    precision),
+        rule='e m, m k o, e p k -> e p o', need_repl=('m', 'k'),
+        arg_specs=lambda P_, e, o: (P_(e, None), P_(None, None, o),
+                                    P_(e, None, None)),
+        result_specs=lambda P_, e, o: (P_(e, None, o),))
+
+
+@functools.partial(jax.jit, static_argnames=('interpret', 'precision'))
+def fused_pairwise_conv(h: jnp.ndarray, w3: jnp.ndarray, v2: jnp.ndarray,
+                        interpret: bool = False,
+                        precision=None) -> jnp.ndarray:
+    """h [E, mid], w3 [mid, IF, O], v2 [E, P, IF] -> out [E, P, O] (f32).
+
+    Fold the radial bias by appending a ones column to h and the bias row
+    to w3 before calling (see PairwiseConvSE3). `precision` feeds the
+    in-kernel MXU dots (captured from jax.default_matmul_precision by the
+    caller — the kernel body traces outside that context). Partitions
+    over sharded edge/output-channel axes (see the SPMD rules above).
+    """
+    return _fwd_partitioned(interpret, precision)(h, w3, v2)
 
 
 def pallas_available() -> bool:
@@ -264,18 +361,7 @@ def _pick_blocks_bx(E: int, C: int, O: int, P: int, Q: int, F: int,
     return 128, 8
 
 
-@functools.partial(jax.jit, static_argnames=('interpret', 'precision'))
-def fused_pairwise_conv_bx(h: jnp.ndarray, w3: jnp.ndarray,
-                           basis: jnp.ndarray, x: jnp.ndarray,
-                           interpret: bool = False,
-                           precision=None) -> jnp.ndarray:
-    """Basis-fused forward: h [E, mid], w3 [mid, C*F, O] (i=(c,f)
-    c-major), basis [E, P, Q, F], x [E, C, Q] -> out [E, P, O] (f32).
-
-    Equals fused_pairwise_conv(h, w3, einsum('epqf,ecq->e p (c f)', ...))
-    without ever materializing that V2 tensor in HBM. Bias folding is the
-    caller's job, as in fused_pairwise_conv.
-    """
+def _fused_pairwise_conv_bx_impl(h, w3, basis, x, interpret, precision):
     E, mid = h.shape
     _, P, Q, F = basis.shape
     C = x.shape[1]
@@ -325,6 +411,35 @@ def fused_pairwise_conv_bx(h: jnp.ndarray, w3: jnp.ndarray,
     )(ht, w3t, bt, xt)
 
     return outt.reshape(P, O, Ep).transpose(2, 0, 1)[:E]
+
+
+@functools.lru_cache(maxsize=None)
+def _bx_partitioned(interpret, precision):
+    return _make_partitioned(
+        lambda h, w3, basis, x: _fused_pairwise_conv_bx_impl(
+            h, w3, basis, x, interpret, precision),
+        rule='e m, m i o, e p q f, e c q -> e p o',
+        need_repl=('m', 'i', 'q', 'f', 'c'),
+        arg_specs=lambda P_, e, o: (P_(e, None), P_(None, None, o),
+                                    P_(e, None, None, None),
+                                    P_(e, None, None)),
+        result_specs=lambda P_, e, o: (P_(e, None, o),))
+
+
+@functools.partial(jax.jit, static_argnames=('interpret', 'precision'))
+def fused_pairwise_conv_bx(h: jnp.ndarray, w3: jnp.ndarray,
+                           basis: jnp.ndarray, x: jnp.ndarray,
+                           interpret: bool = False,
+                           precision=None) -> jnp.ndarray:
+    """Basis-fused forward: h [E, mid], w3 [mid, C*F, O] (i=(c,f)
+    c-major), basis [E, P, Q, F], x [E, C, Q] -> out [E, P, O] (f32).
+
+    Equals fused_pairwise_conv(h, w3, einsum('epqf,ecq->e p (c f)', ...))
+    without ever materializing that V2 tensor in HBM. Bias folding is the
+    caller's job, as in fused_pairwise_conv. Partitions over sharded
+    edge/output-channel axes (see the SPMD rules above).
+    """
+    return _bx_partitioned(interpret, precision)(h, w3, basis, x)
 
 
 # --------------------------------------------------------------------- #
@@ -407,16 +522,7 @@ def _bwd_b_kernel(w3f_ref, v2t_ref, gt_ref, dh_ref, *, P, O, bif,
         dh_ref[:] = dh_ref[:] + acc.astype(dh_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=('interpret', 'precision'))
-def fused_pairwise_conv_bwd(h: jnp.ndarray, w3: jnp.ndarray,
-                            v2: jnp.ndarray, g: jnp.ndarray,
-                            interpret: bool = False, precision=None):
-    """Backward of fused_pairwise_conv: returns (dh, dw3, dv2), all f32.
-
-    h [E, mid], w3 [mid, IF, O], v2 [E, P, IF], g [E, P, O].
-    bf16 radial operands are upcast (exactly) and the backward runs in
-    f32 — gradients stay at the policy precision under radial_bf16.
-    """
+def _fused_pairwise_conv_bwd_impl(h, w3, v2, g, interpret, precision):
     h, w3 = h.astype(jnp.float32), w3.astype(jnp.float32)
     E, mid = h.shape
     _, IF, O = w3.shape
@@ -496,3 +602,44 @@ def fused_pairwise_conv_bwd(h: jnp.ndarray, w3: jnp.ndarray,
     dw3 = dw3t.reshape(IFp, O, mid).transpose(2, 0, 1)[:, :IF]
     dv2 = dv2t.transpose(2, 0, 1)[:E, :, :IF]
     return dh, dw3, dv2
+
+
+def _bwd_psums(outs, e, o):
+    dh, dw3, dv2 = outs
+    # dW3 sums over edges (sharded e axes); dH/dV2 sum over the output
+    # channels (sharded o axes under tensor parallelism)
+    if _axis_tuple(e):
+        dw3 = jax.lax.psum(dw3, _axis_tuple(e))
+    if _axis_tuple(o):
+        dh = jax.lax.psum(dh, _axis_tuple(o))
+        dv2 = jax.lax.psum(dv2, _axis_tuple(o))
+    return dh, dw3, dv2
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_partitioned(interpret, precision):
+    return _make_partitioned(
+        lambda h, w3, v2, g: _fused_pairwise_conv_bwd_impl(
+            h, w3, v2, g, interpret, precision),
+        rule='e m, m k o, e p k, e p o -> e m, m k o, e p k',
+        need_repl=('m', 'k'),
+        arg_specs=lambda P_, e, o: (P_(e, None), P_(None, None, o),
+                                    P_(e, None, None), P_(e, None, o)),
+        result_specs=lambda P_, e, o: (P_(e, None), P_(None, None, o),
+                                       P_(e, None, None)),
+        psum_fn=_bwd_psums)
+
+
+@functools.partial(jax.jit, static_argnames=('interpret', 'precision'))
+def fused_pairwise_conv_bwd(h: jnp.ndarray, w3: jnp.ndarray,
+                            v2: jnp.ndarray, g: jnp.ndarray,
+                            interpret: bool = False, precision=None):
+    """Backward of fused_pairwise_conv: returns (dh, dw3, dv2), all f32.
+
+    h [E, mid], w3 [mid, IF, O], v2 [E, P, IF], g [E, P, O].
+    bf16 radial operands are upcast (exactly) and the backward runs in
+    f32 — gradients stay at the policy precision under radial_bf16.
+    Partitions over sharded edge/output-channel axes with the dW3 (and,
+    under tp, dH/dV2) partial sums reduced in the partition body.
+    """
+    return _bwd_partitioned(interpret, precision)(h, w3, v2, g)

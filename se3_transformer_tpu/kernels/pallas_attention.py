@@ -269,23 +269,155 @@ def _fused_attention_bwd_impl(q, k, v, mask, g, heads: int, scale: float,
             dv[:, :n].astype(v.dtype))
 
 
+# --------------------------------------------------------------------- #
+# SPMD partitioning rules
+# --------------------------------------------------------------------- #
+# The kernel is embarrassingly parallel over the node axis n (sequence
+# parallelism — the long-context axis) and over the flattened batch*head
+# axis; the slot (j) and feature (d) axes reduce inside and must be
+# replicated. Without a rule GSPMD treats the Mosaic call as opaque and
+# replicates the sharded operands. The leading axes of q [B*h, ...] and
+# k/v [B*kv_h, ...] are DIFFERENT factor sizes, so the callbacks must
+# check that the shard count divides B*kv_h (and B for the mask): then a
+# q shard's kv-group range [bh//group] lands exactly on the matching k/v
+# shard. Otherwise the leading-axis sharding is dropped (replicated).
+# The backward needs no cross-shard reductions — every cotangent keeps
+# its primal's axes, and multi-query dk/dv accumulation over the head
+# group stays inside a shard (shards contain whole groups by the
+# divisibility condition).
+
+
+def _att_spec_axes(sharding, dim):
+    spec = sharding.spec
+    return spec[dim] if len(spec) > dim else None
+
+
+def _att_axis_tuple(axes):
+    if axes is None:
+        return ()
+    return axes if isinstance(axes, tuple) else (axes,)
+
+
+def _att_resolve(mesh, arg_shapes, has_mask):
+    """(bh_axes, n_axes) consistent with kv-group alignment; None = keep
+    replicated."""
+    def nshards(axes):
+        s = 1
+        for ax in _att_axis_tuple(axes):
+            s *= mesh.shape[ax]
+        return s
+
+    def first_axes(dim):
+        # any operand may carry the sharding (e.g. only the bwd cotangent
+        # is node-sharded when it propagates from downstream)
+        for a in arg_shapes:
+            ax = _att_spec_axes(a.sharding, dim)
+            if ax is not None:
+                return ax
+        return None
+
+    q_sh, k_sh = arg_shapes[0], arg_shapes[1]
+    a = first_axes(0)
+    nax = first_axes(1)
+    if set(_att_axis_tuple(a)) & set(_att_axis_tuple(nax)):
+        a = None  # one mesh axis can't shard both; the node axis wins
+    if a is not None:
+        s = nshards(a)
+        BKV = k_sh.shape[0]
+        B = arg_shapes[3].shape[0] if has_mask else None
+        if BKV % s != 0 or (B is not None and B % s != 0):
+            a = None
+    if nax is not None:
+        s = nshards(nax)
+        if q_sh.shape[1] % s != 0:
+            nax = None
+    return a, nax
+
+
+@functools.lru_cache(maxsize=None)
+def _att_partitioned(heads, scale, interpret, has_mask, bwd):
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P_
+
+    if bwd:
+        def impl(q, k, v, *rest):
+            mask = rest[0] if has_mask else None
+            g = rest[-1]
+            return _fused_attention_bwd_impl(q, k, v, mask, g, heads,
+                                             scale, interpret)
+    else:
+        def impl(q, k, v, *rest):
+            mask = rest[0] if has_mask else None
+            return _fused_attention_fwd_impl(q, k, v, mask, heads, scale,
+                                             interpret)
+
+    @custom_partitioning
+    def f(*args):
+        return impl(*args)
+
+    def specs(P_, a, nax):
+        q_s = P_(a, nax, None)
+        kv_s = P_(a, nax, None, None)
+        arg = [q_s, kv_s, kv_s]
+        if has_mask:
+            arg.append(P_(a, nax, None))
+        if bwd:
+            arg.append(q_s)  # g
+            res = (q_s, kv_s, kv_s)
+        else:
+            res = (q_s,)
+        return tuple(arg), res
+
+    def partition(mesh, arg_shapes, result_shape):
+        a, nax = _att_resolve(mesh, arg_shapes, has_mask)
+        arg_specs, res_specs = specs(P_, a, nax)
+        arg_sh = tuple(NamedSharding(mesh, s) for s in arg_specs)
+        res_sh = tuple(NamedSharding(mesh, s) for s in res_specs)
+        return (mesh, impl, res_sh if bwd else res_sh[0], arg_sh)
+
+    def infer(mesh, arg_shapes, shape):
+        a, nax = _att_resolve(mesh, arg_shapes, has_mask)
+        m = arg_shapes[0].sharding.mesh
+        _, res_specs = specs(P_, a, nax)
+        res = tuple(NamedSharding(m, s) for s in res_specs)
+        return res if bwd else res[0]
+
+    mask_term = ', c n j' if has_mask else ''
+    if bwd:
+        rule = (f'a n d, b n j d, b n j d{mask_term}, a n d '
+                f'-> a n d, b n j d, b n j d')
+    else:
+        rule = f'a n d, b n j d, b n j d{mask_term} -> a n d'
+    # special-factor indices must be sorted by first appearance in the
+    # rule: d (q's last dim) precedes the slot axis j
+    f.def_partition(partition=partition,
+                    infer_sharding_from_operands=infer,
+                    sharding_rule=rule,
+                    need_replication_factors=('d', 'j'))
+    return f
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def fused_attention(q, k, v, mask, heads: int, scale: float,
                     interpret: bool = False):
     """Fused multi-degree attention. q [B*h, n, D], k/v [B*kv_h, n, J, D],
-    mask [B, n, J] bool or None -> [B*h, n, D] float32."""
-    return _fused_attention_fwd_impl(q, k, v, mask, heads, scale, interpret)
+    mask [B, n, J] bool or None -> [B*h, n, D] float32. Partitions over
+    sharded node / batch-head axes (see the SPMD rules above)."""
+    f = _att_partitioned(heads, scale, interpret, mask is not None, False)
+    args = (q, k, v) + ((mask,) if mask is not None else ())
+    return f(*args)
 
 
 def _fa_fwd(q, k, v, mask, heads, scale, interpret):
-    out = _fused_attention_fwd_impl(q, k, v, mask, heads, scale, interpret)
+    out = fused_attention(q, k, v, mask, heads, scale, interpret)
     return out, (q, k, v, mask)
 
 
 def _fa_bwd(heads, scale, interpret, res, g):
     q, k, v, mask = res
-    dq, dk, dv = _fused_attention_bwd_impl(q, k, v, mask, g, heads, scale,
-                                           interpret)
+    f = _att_partitioned(heads, scale, interpret, mask is not None, True)
+    args = (q, k, v) + ((mask,) if mask is not None else ()) + (g,)
+    dq, dk, dv = f(*args)
     return dq, dk, dv, None
 
 

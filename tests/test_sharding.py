@@ -303,3 +303,62 @@ def test_pallas_kernels_partition_under_pjit():
     hlo2 = fn2.lower(*args).compile().as_text()
     assert 'all-gather' not in hlo2
     assert rel(out2, ref2) < 1e-5
+
+
+def test_fused_attention_partitions_under_pjit():
+    """The fused attention kernel's custom_partitioning rules: node axis
+    (sequence parallelism) and batch*head axis partition without
+    all-gathers; an indivisible leading-axis sharding (shards not
+    aligned to kv groups) falls back to replication rather than
+    miscomputing; gradients keep their primal shardings with no
+    cross-shard reductions."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from se3_transformer_tpu.kernels.pallas_attention import (
+        attention_reference, fused_attention,
+    )
+
+    B, h, kvh, n, J, D = 2, 4, 2, 64, 9, 16
+    rng = np.random.RandomState(0)
+    q0 = jnp.asarray(rng.normal(size=(B * h, n, D)), jnp.float32)
+    k0 = jnp.asarray(rng.normal(size=(B * kvh, n, J, D)), jnp.float32)
+    v0 = jnp.asarray(rng.normal(size=(B * kvh, n, J, D)), jnp.float32)
+    mask0 = jnp.asarray(rng.rand(B, n, J) > 0.3).at[:, :, 0].set(True)
+    scale = D ** -0.5
+    ref = attention_reference(q0, k0, v0, mask0, scale)
+
+    def rel(a, b):
+        return float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
+
+    mesh = make_mesh(sp=8)
+    fn = jax.jit(lambda q, k, v, m: fused_attention(q, k, v, m, h, scale,
+                                                    True))
+
+    # node-axis (sequence-parallel) sharding
+    args_n = [jax.device_put(a, NamedSharding(mesh, s)) for a, s in
+              [(q0, P(None, 'sp')), (k0, P(None, 'sp')),
+               (v0, P(None, 'sp')), (mask0, P(None, 'sp'))]]
+    out = fn(*args_n)
+    assert 'sp' in str(out.sharding.spec)
+    assert 'all-gather' not in fn.lower(*args_n).compile().as_text()
+    assert rel(out, ref) < 1e-5
+
+    # leading-axis shard count (8) does not divide B*kv_h (4): falls back
+    # to replication, stays correct
+    args_a = [jax.device_put(a, NamedSharding(mesh, s)) for a, s in
+              [(q0, P('sp')), (k0, P()), (v0, P()), (mask0, P())]]
+    assert rel(fn(*args_a), ref) < 1e-5
+
+    # dp x sp: both axes kept
+    mesh2 = Mesh(np.asarray(jax.devices()).reshape(2, 4), ('dp', 'sp'))
+    args_d = [jax.device_put(a, NamedSharding(mesh2, s)) for a, s in
+              [(q0, P('dp', 'sp')), (k0, P('dp', 'sp')),
+               (v0, P('dp', 'sp')), (mask0, P('dp', 'sp'))]]
+    out3 = fn(*args_d)
+    assert 'dp' in str(out3.sharding.spec) and 'sp' in str(out3.sharding.spec)
+    assert rel(out3, ref) < 1e-5
+
+    # gradients through the partitioned backward
+    g = jax.grad(lambda q, k, v: (fused_attention(
+        q, k, v, mask0, h, scale, True) ** 2).sum(), argnums=(0, 1, 2))
+    for a, b in zip(jax.jit(g)(*args_n[:3]), g(q0, k0, v0)):
+        assert rel(a, b) < 1e-5

@@ -54,12 +54,16 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def save(self, step: int, state: Any):
-        state = jax.device_get(state)
         if self._ckptr is not None:
+            # hand orbax the jax.Arrays as-is: it writes sharded (even
+            # non-fully-addressable multi-host) arrays natively; a
+            # device_get here would gather everything onto one host and
+            # raise outright for global arrays under jax.distributed
             path = self._step_dir(step)
             self._ckptr.save(path, state, force=True)
             self._ckptr.wait_until_finished()
         else:
+            state = jax.device_get(state)
             path = self._step_dir(step) + '.pkl'
             tmp = path + '.tmp'
             with open(tmp, 'wb') as f:
@@ -68,12 +72,21 @@ class CheckpointManager:
         self._gc()
 
     def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
+        """`like` (optional): a pytree matching the saved state. jax.Array
+        leaves restore placed with like's shardings (tp-partitioned
+        training resumes partitioned — no host round trip)."""
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f'no checkpoints in {self.directory}')
         if self._ckptr is not None and os.path.isdir(self._step_dir(step)):
-            target = jax.tree_util.tree_map(np.asarray, jax.device_get(like)) \
-                if like is not None else None
+            target = None
+            if like is not None:
+                def abstract(a):
+                    if isinstance(a, jax.Array):
+                        return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                    sharding=a.sharding)
+                    return np.asarray(a)  # scalars -> 0-d arrays for orbax
+                target = jax.tree_util.tree_map(abstract, like)
             return self._ckptr.restore(self._step_dir(step), target)
         with open(self._step_dir(step) + '.pkl', 'rb') as f:
             return pickle.load(f)

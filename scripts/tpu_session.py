@@ -75,9 +75,10 @@ def main():
         failed = True
         log('kernel_smoke FAILED:\n' + traceback.format_exc())
 
+    import bench
+
     log('--- flagship bench ---')
     try:
-        import bench
         rec = bench.main('tpu', fast=False)
         log(f'bench: {rec}')
     except Exception:

@@ -287,15 +287,9 @@ def _fused_attention_bwd_impl(q, k, v, mask, g, heads: int, scale: float,
 # divisibility condition).
 
 
-def _att_spec_axes(sharding, dim):
-    spec = sharding.spec
-    return spec[dim] if len(spec) > dim else None
-
-
-def _att_axis_tuple(axes):
-    if axes is None:
-        return ()
-    return axes if isinstance(axes, tuple) else (axes,)
+from .pallas_pairwise import (
+    _axis_tuple as _att_axis_tuple, _spec_axes as _att_spec_axes,
+)
 
 
 def _att_resolve(mesh, arg_shapes, has_mask):

@@ -77,10 +77,25 @@ def main():
 
     import bench
 
+    def save_bench(rec):
+        # persist to the repo so the numbers survive a tunnel death in a
+        # later stage. JSONL append: a crash mid-write can only lose the
+        # line being written, never earlier sessions' records — and a
+        # save problem must not mark a completed bench as failed
+        try:
+            import json
+            path = os.path.join(os.path.dirname(here),
+                                'BENCH_SESSION.jsonl')
+            with open(path, 'a') as f:
+                f.write(json.dumps(rec) + '\n')
+        except Exception as e:
+            log(f'save_bench warning (bench itself succeeded): {e}')
+
     log('--- flagship bench ---')
     try:
         rec = bench.main('tpu', fast=False)
         log(f'bench: {rec}')
+        save_bench(rec)
     except Exception:
         failed = True
         log('bench FAILED:\n' + traceback.format_exc())
@@ -89,6 +104,7 @@ def main():
     try:
         rec = bench.main('tpu', fast=True)
         log(f'bench fast: {rec}')
+        save_bench(rec)
     except Exception:
         failed = True
         log('bench fast FAILED:\n' + traceback.format_exc())

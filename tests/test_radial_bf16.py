@@ -109,3 +109,30 @@ def test_radial_bf16_pallas_paths_match_xla():
 
         for leaf in jax.tree_util.tree_leaves(jax.grad(loss)(params)):
             assert bool(jnp.isfinite(leaf).all())
+
+
+def test_differentiable_coors_with_full_fast_path():
+    """The fast-bench combination (shared radial + fuse_basis +
+    radial_bf16, interpret kernels) keeps the differentiable_coors
+    contract: nonzero finite coordinate gradients through the basis."""
+    rng = np.random.RandomState(3)
+    feats = jnp.asarray(rng.randint(0, 24, (1, 16)))
+    coors = jnp.asarray(rng.normal(size=(1, 16, 3)), jnp.float32)
+    mask = jnp.ones((1, 16), bool)
+    mod = SE3TransformerModule(
+        num_tokens=24, dim=8, dim_head=8, heads=2, depth=1,
+        attend_self=True, input_degrees=1, num_degrees=2, output_degrees=2,
+        reduce_dim_out=True, differentiable_coors=True, num_neighbors=4,
+        shared_radial_hidden=True, fuse_basis=True, radial_bf16=True,
+        pallas_interpret=True)
+    params = mod.init(jax.random.PRNGKey(0), feats, coors, mask=mask,
+                      return_type=1)['params']
+
+    def loss(c):
+        out = mod.apply({'params': params}, feats, c, mask=mask,
+                        return_type=1)
+        return ((c + out - coors) ** 2).sum()
+
+    g = jax.grad(loss)(coors + 0.1)
+    assert bool(jnp.isfinite(g).all())
+    assert float(jnp.abs(g).max()) > 0

@@ -27,5 +27,8 @@ profile:           ## capture an xprof trace of a training step
 tpu-checks:        ## on-chip equivariance + kernel numerics/speed gate
 	python scripts/tpu_checks.py
 
+tpu-session:       ## full on-chip suite, retried until the chip is free
+	bash scripts/tpu_session_loop.sh
+
 clean-cache:       ## wipe the Q_J and jit caches
 	rm -rf ~/.cache/se3_transformer_tpu

@@ -38,8 +38,6 @@ the XLA path in tests (interpreter mode) and on-chip
 from __future__ import annotations
 
 import functools
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl

@@ -21,7 +21,6 @@ is what you jit / pjit / shard.
 """
 from __future__ import annotations
 
-import math
 from typing import Dict, Optional, Tuple, Union
 
 import flax.linen as nn

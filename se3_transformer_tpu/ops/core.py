@@ -10,7 +10,7 @@ plain matmul over the channel axis, which XLA batches onto the MXU.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict
 
 import flax.linen as nn
 import jax

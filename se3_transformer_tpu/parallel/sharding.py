@@ -11,7 +11,6 @@ collectives rather than a hand-rolled NCCL/MPI layer.
 from __future__ import annotations
 
 import re
-from functools import partial
 from typing import Callable, Optional
 
 import jax

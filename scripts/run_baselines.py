@@ -18,8 +18,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def node_counts():
     # per-config data scale: flagship gets the north-star 1024 nodes,
     # stress configs enough nodes to exercise memory, toys stay toy
-    return dict(toy_denoise=96, flagship=1024, af2_refinement=256,
-                molecular_edges=128, egnn_stress=512)
+    return dict(toy_denoise=96, flagship=1024, flagship_fast=1024,
+                af2_refinement=256, molecular_edges=128, egnn_stress=512)
 
 
 def run_config(name, module, n, steps, rng):
@@ -131,8 +131,8 @@ def main(argv=None):
     names = args.configs or list(RECIPES)
     for name in names:
         builder = RECIPES[name]
-        module = builder(dim=args.flagship_dim) if name == 'flagship' \
-            else builder()
+        module = builder(dim=args.flagship_dim) \
+            if name.startswith('flagship') else builder()
         rng = np.random.RandomState(0)
         rec = run_config(name, module, counts[name], args.steps, rng)
         rec['backend'] = backend

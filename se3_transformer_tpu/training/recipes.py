@@ -39,6 +39,17 @@ def flagship(dim: int = 64, num_neighbors: int = 32,
         valid_radius=valid_radius, shared_radial_hidden=True)
 
 
+def flagship_fast(dim: int = 64, num_neighbors: int = 32,
+                  valid_radius: float = 1e5) -> SE3TransformerModule:
+    """flagship + the validated perf knobs (basis-fused kernel, bf16
+    radial trunk); see README's knob table."""
+    return SE3TransformerModule(
+        dim=dim, depth=6, num_degrees=4, heads=8, dim_head=max(8, dim // 8),
+        attend_self=True, num_neighbors=num_neighbors,
+        valid_radius=valid_radius, shared_radial_hidden=True,
+        fuse_basis=True, radial_bf16=True)
+
+
 def af2_refinement(dim: int = 32) -> SE3TransformerModule:
     return SE3TransformerModule(
         dim=dim, depth=2, input_degrees=1, num_degrees=2, output_degrees=2,
@@ -65,6 +76,7 @@ def egnn_stress(dim: int = 16, depth: int = 12) -> SE3TransformerModule:
 RECIPES = {
     'toy_denoise': toy_denoise,
     'flagship': flagship,
+    'flagship_fast': flagship_fast,
     'af2_refinement': af2_refinement,
     'molecular_edges': molecular_edges,
     'egnn_stress': egnn_stress,

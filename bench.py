@@ -60,7 +60,7 @@ def _device_backend_or_cpu(timeout_s: int = 120) -> str:
 DEFAULT_MODE = False
 
 
-def main(backend: str, fast=None):
+def main(backend: str, fast=None, fast_fallback=False):
     """fast=True enables the validated perf knobs (shared radial trunk,
     basis-fused Pallas kernel, bf16 radial) — same model family, same
     training task; the equivariance_l2 field in the record keeps the
@@ -86,7 +86,10 @@ def main(backend: str, fast=None):
             traceback.print_exc(file=sys.stderr)
             print('fast path failed (traceback above); falling back to '
                   'the conservative path', file=sys.stderr)
-            return main(backend, fast=False)
+            # fast_fallback marks the record — a silent conservative
+            # record could be misread downstream as a normal fast run
+            # (ADVICE r2 #3)
+            return main(backend, fast=False, fast_fallback=True)
 
     if backend != 'tpu':
         # NOTE: setting the JAX_PLATFORMS env var here is too late — the
@@ -100,6 +103,7 @@ def main(backend: str, fast=None):
 
     from se3_transformer_tpu.models.se3_transformer import SE3TransformerModule
     from se3_transformer_tpu.parallel.sharding import make_sharded_train_step
+    from se3_transformer_tpu.training import recipes
     from se3_transformer_tpu.utils.compilation_cache import (
         enable_compilation_cache,
     )
@@ -107,22 +111,41 @@ def main(backend: str, fast=None):
     enable_compilation_cache()
 
     if backend == 'tpu':
+        # the tracked config (BASELINE.md): SE3Transformer flagship at
+        # 1024 nodes, num_degrees=4, kNN k=32. dim=64 is the max width
+        # that fits one v5e at this node count (recipes.py); a toy-width
+        # body cannot demonstrate MXU utilization (VERDICT r2 #4)
         num_nodes, num_degrees, batch, num_neighbors, steps = 1024, 4, 1, 32, 20
+        dim = 64
+        recipe_name = 'flagship_fast' if fast else 'flagship'
+        # vector head for the denoise objective: the recipe default
+        # output_degrees=1 is scalar-out (return_type coerced to 0)
+        module = recipes.RECIPES[recipe_name](
+            dim=dim, output_degrees=2, reduce_dim_out=True)
+        num_degrees = module.num_degrees
+        label = f'{recipe_name},dim={dim},depth={module.depth}'
     else:
         # liveness fallback only (wedged/absent TPU): tiny config so the
-        # bench still completes and is honestly labelled backend=cpu
-        num_nodes, num_degrees, batch, num_neighbors, steps = 128, 2, 1, 8, 3
-
-    perf = dict(shared_radial_hidden=True, fuse_basis=True,
-                radial_bf16=True) if fast else dict()
-    module = SE3TransformerModule(
-        num_tokens=24, dim=8, dim_head=8, heads=2, depth=2,
-        attend_self=True, input_degrees=1, num_degrees=num_degrees,
-        output_degrees=2, reduce_dim_out=True, differentiable_coors=True,
-        num_neighbors=num_neighbors, **perf)
+        # bench still completes and is honestly labelled backend=cpu.
+        # steps=10: 3 was too few to distinguish noise from regression
+        # (VERDICT r2 weak #1)
+        num_nodes, num_degrees, batch, num_neighbors, steps = 128, 2, 1, 8, 10
+        perf = dict(shared_radial_hidden=True, fuse_basis=True,
+                    radial_bf16=True) if fast else dict()
+        module = SE3TransformerModule(
+            num_tokens=24, dim=8, dim_head=8, heads=2, depth=2,
+            attend_self=True, input_degrees=1, num_degrees=num_degrees,
+            output_degrees=2, reduce_dim_out=True, differentiable_coors=True,
+            num_neighbors=num_neighbors, **perf)
+        label = 'toy,dim=8,depth=2'
 
     rng = np.random.RandomState(0)
-    seqs = jnp.asarray(rng.randint(0, 24, (batch, num_nodes)))
+    if backend == 'tpu':
+        # flagship takes continuous degree-0 features (no token table)
+        seqs = jnp.asarray(rng.normal(size=(batch, num_nodes, dim)),
+                           jnp.float32)
+    else:
+        seqs = jnp.asarray(rng.randint(0, 24, (batch, num_nodes)))
     coords = jnp.asarray(np.cumsum(
         rng.normal(size=(batch, num_nodes, 3)), axis=1), jnp.float32)
     coords = coords - coords.mean(axis=1, keepdims=True)
@@ -191,7 +214,8 @@ def main(backend: str, fast=None):
         if (RECORD and actual == 'tpu' and not fast) else 1.0
     record = {
         'metric': f'denoise_train_nodes_steps_per_sec_per_chip'
-                  f'(n={num_nodes},deg={num_degrees},k={num_neighbors},'
+                  f'({label},n={num_nodes},deg={num_degrees},'
+                  f'k={num_neighbors},'
                   f'backend={actual}{",fast" if fast else ""})',
         'value': round(nodes_steps_per_sec, 2),
         'unit': f'nodes*steps/sec/{"chip" if actual == "tpu" else "cpu-host"}',
@@ -199,6 +223,8 @@ def main(backend: str, fast=None):
         'equivariance_l2': eq_err,
         'step_ms': round(dt / steps * 1e3, 2),
     }
+    if fast_fallback:
+        record['fast_fallback'] = True
     if step_flops and actual == 'tpu':
         # v5e peak: ~197 TFLOP/s bf16, ~49 TFLOP/s f32 MXU-equivalent;
         # report against bf16 peak (the policy the flagship targets)

@@ -59,6 +59,22 @@ def main():
         ok &= check(f'pairwise bwd dw3 E={E}', dw3, dw3_r)
         ok &= check(f'pairwise bwd dv2 E={E}', dv2, dv2_r)
 
+    # --- radial_bf16 operands under an fp32 context precision: Mosaic
+    # rejects contract_precision<fp32> on bf16 lhs ("Bad lhs type"); the
+    # kernel must force DEFAULT (bf16 multiply, f32 accumulate) ---
+    E, mid, IF, O, P = 300, 129, 24, 8, 5
+    h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
+    w3 = jnp.asarray(rng.normal(size=(mid, IF, O)), jnp.float32)
+    v2 = jnp.asarray(rng.normal(size=(E, P, IF)), jnp.float32)
+    with jax.default_matmul_precision('highest'):
+        ref = jnp.einsum('epk,eko->epo', v2,
+                         jnp.einsum('em,mko->eko', h, w3))
+    with jax.default_matmul_precision('float32'):
+        out = fused_pairwise_conv(h.astype(jnp.bfloat16),
+                                  w3.astype(jnp.bfloat16), v2,
+                                  precision='float32')
+    ok &= check('pairwise fwd bf16-radial @ f32 ctx', out, ref, tol=3e-2)
+
     # --- basis-fused pairwise kernel (forward; bwd shares the kernels
     # gated above via the reconstruct-VJP) ---
     from se3_transformer_tpu.kernels.pallas_pairwise import (
@@ -82,9 +98,15 @@ def main():
     from se3_transformer_tpu.kernels.pallas_attention import (
         attention_reference, fused_attention,
     )
+    # the last two rows are FLAGSHIP-SHAPED (n=1024, J=33): round 3's
+    # first session OOM'd scoped VMEM exactly there while the small
+    # smoke shapes passed — the canary must cover the shapes the model
+    # actually runs
     for (BH, BKV, n, J, D, masked) in [(8, 8, 100, 17, 24, True),
                                        (8, 1, 64, 33, 56, True),
-                                       (4, 4, 128, 9, 8, False)]:
+                                       (4, 4, 128, 9, 8, False),
+                                       (8, 8, 1024, 33, 64, True),
+                                       (2, 2, 1024, 33, 8, True)]:
         q = jnp.asarray(rng.normal(size=(BH, n, D)), jnp.float32)
         k = jnp.asarray(rng.normal(size=(BKV, n, J, D)), jnp.float32)
         v = jnp.asarray(rng.normal(size=(BKV, n, J, D)), jnp.float32)

@@ -88,20 +88,53 @@ def _kernel_nomask(q_ref, k_ref, v_ref, o_ref, *, scale):
     _softmax_weighted_sum(q, k, v, sim, o_ref)
 
 
-def _pick_block_n(n: int, J: int, D: int,
-                  vmem_budget: int = 10 * 2 ** 20) -> int:
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# Mosaic's scoped-vmem stack limit is 16 MiB; stay under it with slack
+# for compiler temporaries. Verified the hard way: the first guess of
+# this budget ignored tiling pads and OOM'd at the flagship shapes
+# (n=1024, J=33) with "Scoped allocation ... exceeded scoped vmem limit".
+_VMEM_LIMIT = 12 * 2 ** 20
+
+
+def _block_row_bytes(J: int, D: int, bwd: bool) -> int:
+    """VMEM bytes per node-row of the kernel working set, with the real
+    TPU tile pads: the minor (lane) dim pads to 128, the second-minor
+    (sublane) dim to 8 — so a [n_b, J, D] kv block occupies
+    n_b * roundup(J,8) * roundup(D,128) f32 slots (D=8 inflates 16x),
+    and a [n_b, J] sim-class array occupies n_b * roundup(J,128). Pallas
+    double-buffers every in/out block across grid steps: x2."""
+    Jp, Dp, Jl = _round_up(J, 8), _round_up(D, 128), _round_up(J, 128)
+    if bwd:
+        # in: k, v [n_b,J,D]; q, g [n_b,D]; mask. out: dq; dk, dv.
+        # sim-class temporaries: sim, p/a, da, dsim + slack
+        blocks = 4 * Jp * Dp + 3 * Dp + Jl
+        temps = 6 * Jl
+    else:
+        # in: k, v; q; mask. out: out. temporaries: sim, p/attn + slack
+        blocks = 2 * Jp * Dp + 2 * Dp + Jl
+        temps = 4 * Jl
+    return (2 * blocks + temps) * 4
+
+
+def _pick_block_n(n: int, J: int, D: int, bwd: bool = False) -> int:
+    row = _block_row_bytes(J, D, bwd)
     for block_n in (512, 256, 128, 64, 32, 16, 8):
-        # k, v [n_b, J, D] dominate; q/out [n_b, D]; sim-class [n_b, J]
-        total = block_n * (2 * J * D + 2 * D + 4 * J) * 4
-        if total <= vmem_budget:
+        if block_n * row <= _VMEM_LIMIT:
             # never exceed n rounded up to the 8-row sublane minimum
             # (a tiny input must not pad to a full 512-row block)
             return min(block_n, max(8, _round_up(n, 8)))
     return 8
 
 
-def _round_up(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
+def fused_attention_fits(J: int, D: int, bwd: bool = True) -> bool:
+    """True when the fused kernel's working set fits the scoped-VMEM
+    budget at SOME block size. The dispatch in ops.attention falls back
+    to the XLA path when this is False (e.g. num_neighbors~512 at a wide
+    dim_head) instead of surfacing a Mosaic VMEM error."""
+    return 8 * _block_row_bytes(J, D, bwd) <= _VMEM_LIMIT
 
 
 @functools.partial(jax.jit, static_argnames=('heads', 'scale', 'interpret'))
@@ -216,8 +249,8 @@ def _fused_attention_bwd_impl(q, k, v, mask, g, heads: int, scale: float,
     BKV, _, J, _ = k.shape
     group = BH // BKV
 
-    # the backward holds ~2x the forward's kv-sized blocks
-    block_n = _pick_block_n(n, J, D, vmem_budget=5 * 2 ** 20)
+    # the backward holds ~2x the forward's kv-sized blocks (dk/dv outputs)
+    block_n = _pick_block_n(n, J, D, bwd=True)
     np_ = _round_up(n, block_n)
     if np_ != n:
         pad = ((0, 0), (0, np_ - n), (0, 0))

@@ -129,9 +129,11 @@ def _fused_pairwise_conv_impl(h, w3, v2, interpret, precision):
     P = v2.shape[1]
 
     # bf16 radial operands (radial_bf16): run the rt dot MXU-native with
-    # f32 accumulation — an explicit precision would upcast and defeat it
+    # f32 accumulation. Must be an EXPLICIT DEFAULT: None inherits the
+    # caller's jax.default_matmul_precision context, and fp32 contract
+    # precision on bf16 operands is rejected by Mosaic ("Bad lhs type")
     if h.dtype == jnp.bfloat16:
-        precision = None
+        precision = jax.lax.Precision.DEFAULT
         if interpret:  # CPU interpret can't dispatch BF16xBF16=F32 dots;
             # the upcast is exact and accumulation is f32 either way
             h, w3 = h.astype(jnp.float32), w3.astype(jnp.float32)
@@ -367,8 +369,10 @@ def _fused_pairwise_conv_bx_impl(h, w3, basis, x, interpret, precision):
     C = x.shape[1]
     O = w3.shape[-1]
     assert w3.shape[1] == C * F, (w3.shape, C, F)
-    if h.dtype == jnp.bfloat16:  # see fused_pairwise_conv
-        precision = None
+    if h.dtype == jnp.bfloat16:  # see fused_pairwise_conv (explicit
+        # DEFAULT — None would inherit a possibly-fp32 context precision,
+        # which Mosaic rejects on bf16 operands)
+        precision = jax.lax.Precision.DEFAULT
         if interpret:
             h, w3 = h.astype(jnp.float32), w3.astype(jnp.float32)
 

@@ -32,22 +32,25 @@ def toy_denoise() -> SE3TransformerModule:
 
 
 def flagship(dim: int = 64, num_neighbors: int = 32,
-             valid_radius: float = 1e5) -> SE3TransformerModule:
+             valid_radius: float = 1e5, **overrides) -> SE3TransformerModule:
+    """overrides: extra SE3TransformerModule fields (e.g. a denoise bench
+    passes output_degrees=2, reduce_dim_out=True for a vector head —
+    the default output_degrees=1 model is scalar-out)."""
     return SE3TransformerModule(
         dim=dim, depth=6, num_degrees=4, heads=8, dim_head=max(8, dim // 8),
         attend_self=True, num_neighbors=num_neighbors,
-        valid_radius=valid_radius, shared_radial_hidden=True)
+        valid_radius=valid_radius, shared_radial_hidden=True, **overrides)
 
 
 def flagship_fast(dim: int = 64, num_neighbors: int = 32,
-                  valid_radius: float = 1e5) -> SE3TransformerModule:
+                  valid_radius: float = 1e5, **overrides) -> SE3TransformerModule:
     """flagship + the validated perf knobs (basis-fused kernel, bf16
     radial trunk); see README's knob table."""
     return SE3TransformerModule(
         dim=dim, depth=6, num_degrees=4, heads=8, dim_head=max(8, dim // 8),
         attend_self=True, num_neighbors=num_neighbors,
         valid_radius=valid_radius, shared_radial_hidden=True,
-        fuse_basis=True, radial_bf16=True)
+        fuse_basis=True, radial_bf16=True, **overrides)
 
 
 def af2_refinement(dim: int = 32) -> SE3TransformerModule:

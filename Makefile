@@ -4,10 +4,8 @@
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
 
-test-fast:         ## math/kernel/unit tests only (skips slow model suites)
-	python -m pytest tests/test_spherical_harmonics.py tests/test_wigner.py \
-	  tests/test_basis.py tests/test_ops.py tests/test_pallas.py \
-	  tests/test_native.py tests/test_ring.py -q
+test-fast:         ## default gate: skips the `slow` tier (config fuzz, full equivariance matrix)
+	python -m pytest tests/ -q -m "not slow"
 
 bench:             ## one-line JSON benchmark (TPU if available, CPU fallback)
 	python bench.py

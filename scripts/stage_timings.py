@@ -7,8 +7,8 @@ optimizer). Stage programs re-do upstream work (a conv needs neighbors
 and basis), so the isolated numbers don't sum to the full step — they
 bound each stage from above and show where the time goes.
 
-Usage: python scripts/stage_timings.py [--nodes 1024] [--dim 8]
-       [--degrees 4] [--neighbors 32] [--depth 2] [--iters 10] [--cpu]
+Usage: python scripts/stage_timings.py [--nodes 1024] [--dim 64]
+       [--degrees 4] [--neighbors 32] [--depth 6] [--iters 10] [--cpu]
 """
 import argparse
 import json
@@ -31,11 +31,13 @@ def timeit(fn, args, iters):
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument('--nodes', type=int, default=1024)
-    ap.add_argument('--dim', type=int, default=8)
+    # defaults = the flagship bench config (recipes.flagship at dim=64);
+    # round-3 toy-width numbers were misleadingly conv-light
+    ap.add_argument('--dim', type=int, default=64)
     ap.add_argument('--degrees', type=int, default=4)
     ap.add_argument('--neighbors', type=int, default=32)
-    ap.add_argument('--depth', type=int, default=2)
-    ap.add_argument('--heads', type=int, default=2)
+    ap.add_argument('--depth', type=int, default=6)
+    ap.add_argument('--heads', type=int, default=8)
     ap.add_argument('--iters', type=int, default=10)
     ap.add_argument('--no-pallas', action='store_true')
     ap.add_argument('--cpu', action='store_true')
@@ -105,7 +107,7 @@ def main(argv=None):
     # --- one attention block at trunk width ---
     # dim_head matches the full model below so this stage number actually
     # upper-bounds the model's attention stage
-    attn = AttentionBlockSE3(fiber=fiber, dim_head=max(8, dim),
+    attn = AttentionBlockSE3(fiber=fiber, dim_head=max(8, dim // 8),
                              heads=args.heads, attend_self=True,
                              pallas=pallas,
                              shared_radial_hidden=True)
@@ -115,11 +117,15 @@ def main(argv=None):
         attn_fn, (aparams, feats), args.iters)
 
     # --- full model forward / train step (denoise-style flagship) ---
+    # reversible + edge_chunks: the flagship memory recipe — a dim-64
+    # deg-4 training step at 1024 nodes OOMs 16 GB HBM without them
+    # (recipes.flagship docstring)
     module = SE3TransformerModule(
-        num_tokens=24, dim=dim, dim_head=max(8, dim), heads=args.heads,
+        num_tokens=24, dim=dim, dim_head=max(8, dim // 8), heads=args.heads,
         depth=args.depth, attend_self=True, input_degrees=1, num_degrees=deg,
         output_degrees=2, reduce_dim_out=True, differentiable_coors=True,
-        num_neighbors=k, pallas=pallas)
+        num_neighbors=k, pallas=pallas, reversible=True, edge_chunks=8,
+        shared_radial_hidden=True)
     seqs = jnp.asarray(rng.randint(0, 24, (b, n)))
     params = jax.jit(module.init, static_argnames=('return_type',))(
         jax.random.PRNGKey(0), seqs, coords, mask=mask,

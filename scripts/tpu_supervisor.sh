@@ -15,14 +15,18 @@ fi
 trap 'rmdir "$LOCK" 2>/dev/null' EXIT
 LOG=/tmp/tpu_session_r2.log
 # only a success logged AFTER this point counts — the log is append-only
-# across rounds and an old "session done (ok)" must not suppress a rerun
-START_LINES=$(wc -l < "$LOG" 2>/dev/null || echo 0)
+# across rounds and an old "session done (ok)" must not suppress a rerun.
+# A unique start marker (not line offsets) survives log truncation or
+# rotation during the wait (ADVICE r2 #4)
+MARK="supervisor-epoch-$$-$(date -u +%s)"
+echo "[supervisor] $MARK waiting" >> "$LOG"
 while pgrep -f "scripts/tpu_session.py" > /dev/null \
     || pgrep -f "tpu_session_loop.sh" > /dev/null; do
   sleep 60
 done
-if tail -n +$((START_LINES + 1)) "$LOG" 2>/dev/null \
-    | grep -q "session done (ok)"; then
+if awk -v m="$MARK" 'index($0, m) {found=1}
+                     found && /session done \(ok\)/ {ok=1}
+                     END {exit !ok}' "$LOG" 2>/dev/null; then
   echo "[supervisor] session succeeded while we waited, nothing to do" >> "$LOG"
   exit 0
 fi

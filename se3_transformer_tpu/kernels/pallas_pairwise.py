@@ -197,13 +197,30 @@ def _axis_tuple(axes):
     return axes if isinstance(axes, tuple) else (axes,)
 
 
-def _edge_o_axes(arg_shapes):
-    """Resolve the (edge, output-channel) sharding axes from the operand
-    shardings: e from h's dim 0, o from w3's dim 2 (all entry points take
-    (h, w3, ...)). A mesh axis can't shard both — on collision the edge
-    sharding wins and w3/g get resharded by the partitioner."""
-    e = _spec_axes(arg_shapes[0].sharding, 0)
-    o = _spec_axes(arg_shapes[1].sharding, 2)
+def _factor_positions(rule, factor):
+    """(operand_idx, dim) pairs where `factor` appears on the lhs of a
+    'e m, m k o, ... -> ...' sharding rule."""
+    lhs = rule.split('->')[0]
+    return [(i, j) for i, op in enumerate(lhs.split(','))
+            for j, f in enumerate(op.split()) if f == factor]
+
+
+def _edge_o_axes(arg_shapes, e_pos, o_pos):
+    """Resolve the (edge, output-channel) sharding axes by scanning EVERY
+    operand that carries the factor (positions parsed from the rule
+    string) — resolving e from h alone would silently drop the edge
+    sharding when h arrives replicated but v2/basis/x/g carry it, and
+    GSPMD would then all-gather the edge tensors (ADVICE r2 #1). A mesh
+    axis can't shard both factors — on collision the edge sharding wins
+    and the o-carrying operands get resharded by the partitioner."""
+    def first(positions):
+        for i, j in positions:
+            ax = _spec_axes(arg_shapes[i].sharding, j)
+            if ax is not None:
+                return ax
+        return None
+
+    e, o = first(e_pos), first(o_pos)
     if set(_axis_tuple(e)) & set(_axis_tuple(o)):
         o = None
     return e, o
@@ -221,6 +238,7 @@ def _make_partitioned(impl, rule, need_repl, arg_specs, result_specs,
     from jax.sharding import NamedSharding, PartitionSpec as P_
 
     single = psum_fn is None and len(result_specs(P_, None, None)) == 1
+    e_pos, o_pos = _factor_positions(rule, 'e'), _factor_positions(rule, 'o')
 
     @custom_partitioning
     def f(*args):
@@ -230,7 +248,7 @@ def _make_partitioned(impl, rule, need_repl, arg_specs, result_specs,
         return tuple(NamedSharding(mesh, s) for s in specs)
 
     def partition(mesh, arg_shapes, result_shape):
-        e, o = _edge_o_axes(arg_shapes)
+        e, o = _edge_o_axes(arg_shapes, e_pos, o_pos)
         arg_sh = _shardings(mesh, arg_specs(P_, e, o))
         res_sh = _shardings(mesh, result_specs(P_, e, o))
 
@@ -241,7 +259,7 @@ def _make_partitioned(impl, rule, need_repl, arg_specs, result_specs,
         return (mesh, lower_fn, res_sh[0] if single else res_sh, arg_sh)
 
     def infer(mesh, arg_shapes, shape):
-        e, o = _edge_o_axes(arg_shapes)
+        e, o = _edge_o_axes(arg_shapes, e_pos, o_pos)
         m = arg_shapes[0].sharding.mesh
         res = _shardings(m, result_specs(P_, e, o))
         return res[0] if single else res
@@ -360,6 +378,19 @@ def _pick_blocks_bx(E: int, C: int, O: int, P: int, Q: int, F: int,
             if cb <= 8:
                 break
             cb = max(8, cb // 2 // 8 * 8)
+    # even the smallest block exceeds the budget: warn with the offending
+    # dims instead of letting Mosaic surface an opaque VMEM overflow at
+    # compile time (ADVICE r2 #2)
+    import warnings
+    bt = P * F * Q * 128
+    total = 4 * (mid * 128 + 8 * F * O * mid + 2 * 8 * F * O * 128
+                 + bt + 8 * Q * 128 + P * O * 128)
+    warnings.warn(
+        f'fused bx kernel working set ~{total / 2**20:.1f} MiB exceeds '
+        f'the {vmem_budget / 2**20:.0f} MiB VMEM budget even at the '
+        f'smallest block (P={P}, Q={Q}, F={F}, O={O}, mid={mid}); '
+        f'expect a Mosaic VMEM error — use the unfused path for this '
+        f'config', stacklevel=3)
     return 128, 8
 
 

@@ -168,14 +168,28 @@ class AttentionSE3(nn.Module):
                                       ((0, 0), (0, 0), (num_left_pad, 0)),
                                       constant_values=True)
 
+            # auto-dispatch default: XLA. Measured on a v5e (round 3,
+            # tpu_checks): fused 4.40 ms vs XLA 3.95 ms (0.90x) at the
+            # flagship-relevant J=33 — the kernel's D-on-lanes layout
+            # pads small dim_head*m to 128 lanes, wasting VPU work, and
+            # attention is <10% of a block's time (conv: 58 ms). The
+            # kernel stays available via pallas_attention=True.
             use_fused = self.pallas_attention if self.pallas_attention \
-                is not None else jax.default_backend() == 'tpu'
+                is not None else False
             from ..kernels.pallas_attention import fused_attention_fits
             if use_fused and not self.pallas_attention_interpret \
                     and not fused_attention_fits(J, self.dim_head * m):
                 # a too-large slot axis (e.g. num_neighbors~512 at a wide
                 # dim_head) must fall back to the XLA path, not surface a
                 # Mosaic scoped-VMEM error (VERDICT r2 weak #4)
+                if self.pallas_attention:  # explicit opt-in: say so —
+                    # silently measuring XLA as "fused" corrupts benchmarks
+                    import warnings
+                    warnings.warn(
+                        f'pallas_attention=True but the fused kernel '
+                        f'working set (J={J}, D={self.dim_head * m}) '
+                        f'exceeds the scoped-VMEM budget at any block '
+                        f'size; using the XLA path', stacklevel=2)
                 use_fused = False
             if use_fused or self.pallas_attention_interpret:
                 from ..kernels.pallas_attention import fused_attention

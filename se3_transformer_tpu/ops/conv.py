@@ -98,11 +98,25 @@ def _use_pallas(pallas: Optional[bool], interpret: bool) -> bool:
 
 def _stream_node_chunks(contract, operands, edge_chunks: int):
     """Run contract(*operands) streaming the node axis (axis 1) in
-    `edge_chunks` remat'd chunks via lax.map (the memory ceiling for
-    huge channel counts; peak extra memory is one chunk's working set)."""
+    remat'd chunks via lax.map (the memory ceiling for huge channel
+    counts; peak extra memory is one chunk's working set). `edge_chunks`
+    is an UPPER BOUND: the largest divisor of n that does not exceed it
+    is used, so a recipe tuned for n=1024 (chunks=8) still runs at any
+    smaller/odd n instead of tripping a divisibility assert."""
     n = operands[0].shape[1]
-    c = edge_chunks
-    assert n % c == 0, f'nodes {n} must divide into {c} edge_chunks'
+    c = max(d for d in range(1, min(edge_chunks, n) + 1) if n % d == 0)
+    if c == 1 and edge_chunks > 1:
+        # no divisor -> no streaming at all: the memory ceiling the
+        # caller asked for is NOT in effect (a dim-64 flagship step
+        # needs it to fit 16 GB HBM) — say so instead of letting the
+        # allocator OOM opaquely
+        import warnings
+        warnings.warn(
+            f'edge_chunks={edge_chunks} requested but n={n} has no '
+            f'divisor in [2, {edge_chunks}] — edge streaming is '
+            f'DISABLED for this shape; expect the un-streamed memory '
+            f'footprint (pad n to a composite size to restore it)',
+            stacklevel=3)
 
     def split(a):
         a = a.reshape(a.shape[0], c, n // c, *a.shape[2:])

@@ -83,6 +83,37 @@ class Neighborhood(NamedTuple):
     rel_dist: jnp.ndarray         # [b, n, k]
 
 
+def _top_k_smallest(ranking: jnp.ndarray, k: int,
+                    block: int = 128) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """EXACT smallest-k over the last axis, blockwise.
+
+    lax.top_k lowers to a bitonic sort over the full row — measured 66 ms
+    for [1, 1024, 1023] k=32 on a v5e (round-3 stage_timings), as
+    expensive as an entire ConvSE3. Splitting the row into `block`-wide
+    chunks, taking k per chunk, then k of the k*chunks candidates is
+    exact (any global top-k element is top-k within its chunk) and sorts
+    only `block`-wide rows. Ties break toward lower source index, like a
+    single top_k (candidates stay in ascending-index order across
+    chunks).
+    """
+    m = ranking.shape[-1]
+    if m <= max(block, 2 * k):
+        neg_vals, idx = jax.lax.top_k(-ranking, k)
+        return -neg_vals, idx
+    nb = -(-m // block)
+    pad = nb * block - m
+    x = jnp.pad(ranking, [(0, 0)] * (ranking.ndim - 1) + [(0, pad)],
+                constant_values=FINF)
+    xb = x.reshape(*ranking.shape[:-1], nb, block)
+    kb = min(k, block)
+    neg_v, i_local = jax.lax.top_k(-xb, kb)            # [..., nb, kb]
+    i_global = i_local + (jnp.arange(nb) * block)[..., :, None]
+    cand_v = (-neg_v).reshape(*ranking.shape[:-1], nb * kb)
+    cand_i = i_global.reshape(*ranking.shape[:-1], nb * kb)
+    neg_v2, sel = jax.lax.top_k(-cand_v, k)
+    return -neg_v2, jnp.take_along_axis(cand_i, sel, axis=-1)
+
+
 def select_neighbors(
     rel_pos: jnp.ndarray,          # [b, n, n-1, 3] self-excluded offsets
     indices: jnp.ndarray,          # [b, n, n-1] self-excluded source ids
@@ -115,8 +146,7 @@ def select_neighbors(
         future = jnp.triu(jnp.ones((n, n - 1), bool))
         ranking = jnp.where(future[None], FINF, ranking)
 
-    neg_vals, nearest = jax.lax.top_k(-ranking, total_neighbors)
-    dist_rank = -neg_vals
+    dist_rank, nearest = _top_k_smallest(ranking, total_neighbors)
     valid = dist_rank <= valid_radius
 
     out_dist = batched_index_select(rel_dist, nearest, axis=2)

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.neighbors import FINF
+from ..ops.neighbors import FINF, _top_k_smallest
 
 
 def _ring_knn_local(coors_q: jnp.ndarray, coors_src: jnp.ndarray,
@@ -65,8 +65,7 @@ def _ring_knn_local(coors_q: jnp.ndarray, coors_src: jnp.ndarray,
         cand_i = jnp.concatenate(
             [best_i, jnp.broadcast_to(src_global[None, None], d.shape)],
             axis=-1)
-        neg_top, sel = jax.lax.top_k(-cand_d, k)
-        new_d = -neg_top
+        new_d, sel = _top_k_smallest(cand_d, k)
         new_i = jnp.take_along_axis(cand_i, sel, axis=-1)
 
         # rotate source blocks one hop around the ring (device i receives
@@ -114,5 +113,5 @@ def dense_knn(coors: jnp.ndarray, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     d = jnp.linalg.norm(coors[:, :, None] - coors[:, None, :], axis=-1)
     n = coors.shape[1]
     d = jnp.where(jnp.eye(n, dtype=bool)[None], FINF, d)
-    neg, idx = jax.lax.top_k(-d, k)
-    return -neg, idx.astype(jnp.int32)
+    dist, idx = _top_k_smallest(d, k)
+    return dist, idx.astype(jnp.int32)

@@ -160,15 +160,18 @@ def make_accumulating_train_step(loss_fn: Callable, optimizer,
         grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, losses.mean()
+        # per-micro-step losses ride along (the reference prints every
+        # outer step's loss, denoise.py:91 — the mean alone hides a
+        # diverging micro-batch); same 4-arity as make_sharded_train_step
+        return params, opt_state, losses.mean(), losses
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0, 1))
     repl = replicated(mesh)
     if tensor_parallel:
         return jax.jit(step, in_shardings=(None, None, None, repl),
-                       out_shardings=(None, None, repl),
+                       out_shardings=(None, None, repl, repl),
                        donate_argnums=(0, 1))
     return jax.jit(step, in_shardings=(repl, repl, None, repl),
-                   out_shardings=(repl, repl, repl),
+                   out_shardings=(repl, repl, repl, repl),
                    donate_argnums=(0, 1))

@@ -32,22 +32,36 @@ def toy_denoise() -> SE3TransformerModule:
 
 
 def flagship(dim: int = 64, num_neighbors: int = 32,
-             valid_radius: float = 1e5, **overrides) -> SE3TransformerModule:
+             valid_radius: float = 1e5, depth: int = 6,
+             **overrides) -> SE3TransformerModule:
     """overrides: extra SE3TransformerModule fields (e.g. a denoise bench
     passes output_degrees=2, reduce_dim_out=True for a vector head —
-    the default output_degrees=1 model is scalar-out)."""
+    the default output_degrees=1 model is scalar-out).
+
+    Memory: a dim=64 deg-4 TRAINING step at 1024 nodes needs ~24 GB of
+    HBM un-checkpointed (the [E, P, sum c_in*F] edge tensors of all 6
+    blocks' convs are saved for the backward; measured OOM on a 16 GB
+    v5e, round-3 session log) — so the flagship recipe is defined WITH
+    reversible=True (per-block remat) and edge_chunks=8 (the edge
+    contraction streams in remat'd node chunks): that is what 'fits one
+    v5e' means here."""
+    overrides.setdefault('reversible', True)
+    overrides.setdefault('edge_chunks', 8)
     return SE3TransformerModule(
-        dim=dim, depth=6, num_degrees=4, heads=8, dim_head=max(8, dim // 8),
+        dim=dim, depth=depth, num_degrees=4, heads=8, dim_head=max(8, dim // 8),
         attend_self=True, num_neighbors=num_neighbors,
         valid_radius=valid_radius, shared_radial_hidden=True, **overrides)
 
 
 def flagship_fast(dim: int = 64, num_neighbors: int = 32,
-                  valid_radius: float = 1e5, **overrides) -> SE3TransformerModule:
+                  valid_radius: float = 1e5, depth: int = 6,
+                  **overrides) -> SE3TransformerModule:
     """flagship + the validated perf knobs (basis-fused kernel, bf16
     radial trunk); see README's knob table."""
+    overrides.setdefault('reversible', True)
+    overrides.setdefault('edge_chunks', 8)
     return SE3TransformerModule(
-        dim=dim, depth=6, num_degrees=4, heads=8, dim_head=max(8, dim // 8),
+        dim=dim, depth=depth, num_degrees=4, heads=8, dim_head=max(8, dim // 8),
         attend_self=True, num_neighbors=num_neighbors,
         valid_radius=valid_radius, shared_radial_hidden=True,
         fuse_basis=True, radial_bf16=True, **overrides)

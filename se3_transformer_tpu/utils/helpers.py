@@ -59,10 +59,15 @@ def batched_index_select(values: jnp.ndarray, indices: jnp.ndarray, axis: int = 
     batch_dims = values.shape[:axis]
     idx_extra = indices.shape[len(batch_dims):]
     flat_idx = indices.reshape(*batch_dims, -1)
-    # expand to match trailing value dims
-    expanded = flat_idx.reshape(flat_idx.shape + (1,) * len(value_dims))
-    expanded = jnp.broadcast_to(expanded, flat_idx.shape + value_dims)
-    out = jnp.take_along_axis(values, expanded, axis=axis)
+    # vmap'd jnp.take keeps the gather indices at [batch..., K]: the old
+    # take_along_axis formulation broadcast them across every trailing
+    # value dim, and XLA materialized s32 index tensors of the FULL
+    # gathered shape with a tile-padded trailing singleton — 1.00 GB
+    # EACH at flagship scale (E=32768, dim=64; round-3 HBM OOM dump)
+    take = lambda v, i: jnp.take(v, i, axis=0)  # noqa: E731
+    for _ in batch_dims:
+        take = jax.vmap(take)
+    out = take(values, flat_idx)
     return out.reshape(*batch_dims, *idx_extra, *value_dims)
 
 

@@ -9,6 +9,8 @@ import pytest
 
 from se3_transformer_tpu import SE3TransformerModule
 
+pytestmark = pytest.mark.slow
+
 CONFIGS = [
     # memory-lean attention stack + gated norms + fourier + preconvs
     dict(dim=6, depth=2, num_degrees=2, num_neighbors=4, attend_self=True,

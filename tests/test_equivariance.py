@@ -5,10 +5,13 @@ methodology; see .claude/skills/verify/SKILL.md)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from se3_transformer_tpu import SE3Transformer
 from se3_transformer_tpu.so3 import rot
 from se3_transformer_tpu.utils import fourier_encode
+
+pytestmark = pytest.mark.slow
 
 F32 = jnp.float32
 

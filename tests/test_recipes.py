@@ -31,7 +31,7 @@ def _inputs(module, n=12, b=1, seed=0):
 def test_recipe_forward_and_grad(name):
     builder = RECIPES[name]
     module = builder(dim=16) if name != 'toy_denoise' else builder()
-    if name == 'egnn_stress':
+    if name in ('egnn_stress', 'flagship', 'flagship_fast'):
         module = RECIPES[name](dim=8, depth=2)  # tiny depth for CI speed
 
     feats, coors, kwargs = _inputs(module)

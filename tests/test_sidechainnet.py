@@ -68,6 +68,37 @@ def test_batches_apply_resolution_mask(tmp_path):
     assert got
 
 
+def test_committed_protein_fixture_trains(tmp_path):
+    """The COMMITTED genuine-format fixture (real sequences, ideal
+    Engh–Huber backbone geometry, exact sidechainnet pickle layout —
+    scripts/make_protein_fixture.py) converts and trains end to end with
+    decreasing loss, without the sidechainnet package (VERDICT r2 #5)."""
+    import os
+    import sys
+    fixture = os.path.join(os.path.dirname(__file__), 'fixtures',
+                           'mini_sidechainnet.pkl')
+    assert os.path.exists(fixture), 'committed fixture missing'
+    path = convert_sidechainnet(fixture, str(tmp_path / 'mini.npz'),
+                                splits=('train', 'valid-10'))
+
+    ds = PointCloudDataset.load(path)
+    assert len(ds) == 4  # ubiquitin, trp-cage, villin, insulin B
+    # ubiquitin's unresolved LRGG tail: masked but present
+    assert int(np.sum(~np.load(path)['masks'])) == 4 * BACKBONE_ATOMS
+
+    import denoise as denoise_cli
+    argv = sys.argv
+    sys.argv = ['denoise.py', '--steps', '12', '--nodes', '64',
+                '--degrees', '2', '--accum', '1', '--dataset', path]
+    try:
+        history = denoise_cli.main()
+    finally:
+        sys.argv = argv
+    losses = [h['loss'] for h in history]
+    assert all(np.isfinite(l) for l in losses)
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+
+
 def test_training_loss_decreases_on_converted_data(tmp_path):
     """The VERDICT gate: loss decreases on real-format (converted) data,
     end to end through denoise.py --dataset."""

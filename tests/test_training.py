@@ -66,10 +66,13 @@ def test_accumulating_step():
     opt_state = opt.init(params)
     step = make_accumulating_train_step(loss_fn, opt, accum_steps=4)
     batch = {'x': jnp.ones((4, 8)), 'y': 2 * jnp.ones((4, 8))}
-    params, opt_state, loss = step(params, opt_state, batch,
-                                   jax.random.PRNGKey(0))
+    params, opt_state, loss, micro_losses = step(params, opt_state, batch,
+                                                 jax.random.PRNGKey(0))
     assert np.isfinite(float(loss))
     assert float(params['w']) > 0  # moved toward y/x = 2
+    # per-micro-step losses ride along (VERDICT r2 weak #6)
+    assert micro_losses.shape == (4,)
+    assert np.allclose(float(loss), np.asarray(micro_losses).mean())
 
 
 def test_params_serialization_roundtrip(tmp_path):

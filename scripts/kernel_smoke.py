@@ -94,6 +94,26 @@ def main():
         out = fused_pairwise_conv_bx(h, w3, bas, x, precision='highest')
         ok &= check(f'pairwise bx fwd E={E} C={C} Q={Q} F={F}', out, ref)
 
+    # --- MXU one-hot gather vs jnp.take at a flagship-shaped gather:
+    # the auto heuristic only fires on TPU, so CPU tests never see the
+    # on-chip numerics of the matmul path ---
+    from se3_transformer_tpu.utils.helpers import (
+        _onehot_gather, _use_onehot_gather,
+    )
+    vals = jnp.asarray(rng.normal(size=(1, 1024, 64, 7)), jnp.float32)
+    gidx = jnp.asarray(rng.randint(0, 1024, (1, 1024 * 33)), jnp.int32)
+    if _use_onehot_gather(vals, gidx, 1):
+        oh = jax.jit(_onehot_gather)(vals, gidx)
+        tk = jax.jit(lambda v, i: jax.vmap(
+            lambda vv, ii: jnp.take(vv, ii, axis=0))(v, i))(vals, gidx)
+        ok &= check('onehot gather vs take (flagship shape)', oh, tk,
+                    tol=1e-6)
+    else:
+        # run-everything contract: never abort the remaining canaries
+        print('onehot gather heuristic OFF at flagship shape '
+              f'(backend={jax.default_backend()}) [FAIL]')
+        ok &= jax.default_backend() != 'tpu'
+
     # --- attention kernel ---
     from se3_transformer_tpu.kernels.pallas_attention import (
         attention_reference, fused_attention,

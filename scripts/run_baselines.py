@@ -129,19 +129,31 @@ def main(argv=None):
     counts = node_counts()
     results = []
     names = args.configs or list(RECIPES)
+    failed = []
     for name in names:
         builder = RECIPES[name]
         module = builder(dim=args.flagship_dim) \
             if name.startswith('flagship') else builder()
         rng = np.random.RandomState(0)
-        rec = run_config(name, module, counts[name], args.steps, rng)
+        # one config failing (e.g. an OOM at a new width) must not lose
+        # the configs already measured — record and continue
+        try:
+            rec = run_config(name, module, counts[name], args.steps, rng)
+        except Exception as e:  # noqa: BLE001
+            print(f'{name} FAILED: {type(e).__name__}: {str(e)[:300]}',
+                  file=sys.stderr)
+            failed.append(name)
+            continue
         rec['backend'] = backend
         print(json.dumps(rec))
         results.append(rec)
-    if args.out:
-        with open(args.out, 'w') as f:
-            json.dump(results, f, indent=1)
+        if args.out:  # write-as-you-go: survive a later config crashing
+            with open(args.out, 'w') as f:
+                json.dump(results, f, indent=1)
+    if args.out and results:
         print(f'wrote {args.out}')
+    if failed:
+        raise RuntimeError(f'configs failed: {failed}')
 
 
 if __name__ == '__main__':

@@ -82,15 +82,20 @@ def main(argv=None):
         return select_neighbors(rel_pos, idx_base, k, 1e5,
                                 pair_mask=None, neighbor_mask=None)
 
+    def record(stage, value):
+        # print as we go: a failure in a later stage (e.g. an OOM at the
+        # train step) must not lose the numbers already measured
+        report['stage_ms'][stage] = round(value, 3)
+        print(f'stage {stage}: {report["stage_ms"][stage]} ms', flush=True)
+
     nf = jax.jit(neighbors_fn)
     hood, nearest = nf(coords)
-    report['stage_ms']['neighbors'] = timeit(nf, (coords,), args.iters)
+    record('neighbors', timeit(nf, (coords,), args.iters))
 
     # --- basis construction on the selected edges ---
     basis_fn = jax.jit(lambda rp: get_basis(rp, deg - 1))
     basis = basis_fn(hood.rel_pos)
-    report['stage_ms']['basis'] = timeit(
-        basis_fn, (hood.rel_pos,), args.iters)
+    record('basis', timeit(basis_fn, (hood.rel_pos,), args.iters))
 
     # --- one ConvSE3 at trunk width ---
     fiber = Fiber.create(deg, dim)
@@ -102,7 +107,7 @@ def main(argv=None):
     cargs = (feats, edge_info, hood.rel_dist, basis)
     cparams = jax.jit(conv.init)(jax.random.PRNGKey(0), *cargs)
     conv_fn = jax.jit(lambda p, f: conv.apply(p, f, *cargs[1:]))
-    report['stage_ms']['conv'] = timeit(conv_fn, (cparams, feats), args.iters)
+    record('conv', timeit(conv_fn, (cparams, feats), args.iters))
 
     # --- one attention block at trunk width ---
     # dim_head matches the full model below so this stage number actually
@@ -113,8 +118,7 @@ def main(argv=None):
                              shared_radial_hidden=True)
     aparams = jax.jit(attn.init)(jax.random.PRNGKey(0), *cargs)
     attn_fn = jax.jit(lambda p, f: attn.apply(p, f, *cargs[1:]))
-    report['stage_ms']['attention_block'] = timeit(
-        attn_fn, (aparams, feats), args.iters)
+    record('attention_block', timeit(attn_fn, (aparams, feats), args.iters))
 
     # --- full model forward / train step (denoise-style flagship) ---
     # reversible + edge_chunks: the flagship memory recipe — a dim-64
@@ -132,8 +136,7 @@ def main(argv=None):
         return_type=1)['params']
     fwd = jax.jit(lambda p, c: module.apply(
         {'params': p}, seqs, c, mask=mask, return_type=1))
-    report['stage_ms']['model_forward'] = timeit(
-        fwd, (params, coords), args.iters)
+    record('model_forward', timeit(fwd, (params, coords), args.iters))
 
     opt = optax.adam(1e-4)
     opt_state = opt.init(params)
@@ -158,10 +161,8 @@ def main(argv=None):
     for _ in range(args.iters):
         p2, o2, loss = train_step(p2, o2, coords, key)
     jax.block_until_ready(loss)
-    report['stage_ms']['train_step'] = (time.time() - t0) / args.iters * 1e3
+    record('train_step', (time.time() - t0) / args.iters * 1e3)
 
-    report['stage_ms'] = {s: round(v, 3)
-                          for s, v in report['stage_ms'].items()}
     print(json.dumps(report))
     return report
 

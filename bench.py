@@ -15,8 +15,13 @@ import json
 import multiprocessing
 import time
 
-# first recorded nodes*steps/sec/chip on TPU v5e-1 (update as it improves)
-RECORD = None
+# first recorded nodes*steps/sec/chip on TPU v5e-1 (update as they improve)
+# 2026-08-01 round 3 session 5: flagship dim=64 depth=6 deg=4 k=32 n=1024
+# (remat recipe, MXU one-hot gather); conservative step_ms=3902.72,
+# fast (fuse_basis + radial_bf16) step_ms=3307.78. Each path compares
+# against its own record — they run different programs.
+RECORD = 262.38
+FAST_RECORD = 309.57
 
 
 def _probe_device(q):
@@ -56,16 +61,22 @@ def _device_backend_or_cpu(timeout_s: int = 120) -> str:
 # True = perf knobs, 'auto' = try fast, fall back to the conservative
 # path if the fast path RAISES (a wedged tunnel hangs either path — the
 # subprocess probe above guards init, the driver's own timeout guards
-# the rest). Flip to 'auto' once the fast path is validated on hardware.
-DEFAULT_MODE = False
+# the rest). 'auto' since round-3 session 5: the fast path validated on
+# hardware END TO END — 309.57 nodes*steps/s vs 262.38 conservative
+# (+18%), kernel_smoke bx + radial_bf16 canaries green on chip.
+DEFAULT_MODE = 'auto'
 
 
 def main(backend: str, fast=None, fast_fallback=False):
     """fast=True enables the validated perf knobs (shared radial trunk,
     basis-fused Pallas kernel, bf16 radial) — same model family, same
-    training task; the equivariance_l2 field in the record keeps the
-    accuracy story honest. fast='auto' tries the fast path and falls
-    back to the conservative one on any failure. Default: the
+    training task. Accuracy evidence: equivariance_l2 is measured on
+    CPU runs (and on TPU with SE3_TPU_BENCH_EQ=1); default TPU runs
+    record None and rely on scripts/tpu_checks.py's on-chip gate
+    (3.66e-07 @ f32, radial_bf16 3.07e-07) because the second
+    full-flagship f32 compile repeatedly wedged the tunnel. fast='auto'
+    tries the fast path and falls back to the conservative one on any
+    failure (record flagged fast_fallback). Default: the
     SE3_TPU_BENCH_FAST env var ('1'/'true'/'auto'/...), else
     DEFAULT_MODE."""
     import os
@@ -206,21 +217,29 @@ def main(backend: str, fast=None, fast_fallback=False):
     # over the tunnel, and a tunnel death here must not lose the timing
     # already measured (round-3 session 4 lost a complete 20-step run
     # exactly this way)
-    from se3_transformer_tpu.utils.validation import equivariance_l2
-    try:
-        eq_err = equivariance_l2(module, params, seqs, coords, masks)
-    except Exception as e:  # noqa: BLE001
-        import sys
-        print(f'equivariance check failed ({type(e).__name__}); '
-              f'recording throughput without it', file=sys.stderr)
-        eq_err = None
+    eq_err = None
+    # On TPU this is a SECOND multi-minute compile of the full flagship
+    # at f32 matmul precision, and it wedged the tunnel for ~25 min in
+    # all five round-3 attempts (the timing record survives only thanks
+    # to the guard). The on-chip equivariance evidence lives in
+    # scripts/tpu_checks.py (model 3.66e-07 @ f32; radial_bf16
+    # 3.07e-07); opt back in with SE3_TPU_BENCH_EQ=1.
+    if jax.default_backend() != 'tpu' \
+            or os.environ.get('SE3_TPU_BENCH_EQ', '').lower() in (
+                '1', 'true', 'yes', 'on'):
+        from se3_transformer_tpu.utils.validation import equivariance_l2
+        try:
+            eq_err = equivariance_l2(module, params, seqs, coords, masks)
+        except Exception as e:  # noqa: BLE001
+            print(f'equivariance check failed ({type(e).__name__}); '
+                  f'recording throughput without it', file=sys.stderr)
 
     actual = jax.default_backend()
-    # RECORD is a TPU flagship-config number on the conservative path; a
-    # CPU fallback run OR a fast-mode run measures a different workload,
-    # so comparing would fabricate a regression/speedup
-    vs = nodes_steps_per_sec / RECORD \
-        if (RECORD and actual == 'tpu' and not fast) else 1.0
+    # each path compares against its own TPU flagship record (different
+    # programs); a CPU fallback run measures a different workload, so
+    # comparing would fabricate a regression/speedup
+    ref = FAST_RECORD if fast else RECORD
+    vs = nodes_steps_per_sec / ref if (ref and actual == 'tpu') else 1.0
     record = {
         'metric': f'denoise_train_nodes_steps_per_sec_per_chip'
                   f'({label},n={num_nodes},deg={num_degrees},'

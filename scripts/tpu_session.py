@@ -137,6 +137,15 @@ def main():
         failed = True
         log('run_baselines FAILED:\n' + traceback.format_exc())
 
+    log('--- knob/width probe (edge_chunks x dim) ---')
+    try:
+        import tpu_probe
+        tpu_probe.main(['--steps', '3'])
+        log('tpu_probe: completed (PROBE_TPU.jsonl)')
+    except Exception:
+        failed = True
+        log('tpu_probe FAILED:\n' + traceback.format_exc())
+
     log('--- flagship profile ---')
     try:
         import numpy as np

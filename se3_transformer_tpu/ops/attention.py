@@ -169,11 +169,13 @@ class AttentionSE3(nn.Module):
                                       constant_values=True)
 
             # auto-dispatch default: XLA. Measured on a v5e (round 3,
-            # tpu_checks): fused 4.40 ms vs XLA 3.95 ms (0.90x) at the
-            # flagship-relevant J=33 — the kernel's D-on-lanes layout
-            # pads small dim_head*m to 128 lanes, wasting VPU work, and
-            # attention is <10% of a block's time (conv: 58 ms). The
-            # kernel stays available via pallas_attention=True.
+            # tpu_checks) at the flagship-relevant J=33: 0.90x vs XLA
+            # in one session, 1.05x in another after the gather fix —
+            # within session noise, and the kernel's D-on-lanes layout
+            # pads small dim_head*m to 128 lanes, wasting VPU work.
+            # Attention is <1% of the flagship step, so the conservative
+            # default wins; the kernel stays available via
+            # pallas_attention=True.
             use_fused = self.pallas_attention if self.pallas_attention \
                 is not None else False
             from ..kernels.pallas_attention import fused_attention_fits

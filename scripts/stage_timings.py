@@ -39,6 +39,11 @@ def main(argv=None):
     ap.add_argument('--depth', type=int, default=6)
     ap.add_argument('--heads', type=int, default=8)
     ap.add_argument('--iters', type=int, default=10)
+    ap.add_argument('--full', action='store_true',
+                    help='also time the full model forward + train step '
+                         '(redundant with bench.py records; the '
+                         'differentiable_coors compile repeatedly '
+                         'wedged the tunnel in round 3)')
     ap.add_argument('--no-pallas', action='store_true')
     ap.add_argument('--cpu', action='store_true')
     args = ap.parse_args(argv)
@@ -119,6 +124,10 @@ def main(argv=None):
     aparams = jax.jit(attn.init)(jax.random.PRNGKey(0), *cargs)
     attn_fn = jax.jit(lambda p, f: attn.apply(p, f, *cargs[1:]))
     record('attention_block', timeit(attn_fn, (aparams, feats), args.iters))
+
+    if not args.full:
+        print(json.dumps(report))
+        return report
 
     # --- full model forward / train step (denoise-style flagship) ---
     # reversible + edge_chunks: the flagship memory recipe — a dim-64

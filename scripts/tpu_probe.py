@@ -18,12 +18,11 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def probe_point(dim, chunks, fast, steps, n=1024, k=32):
+def probe_point(dim, chunks, fast, steps, n=1024, k=32, reversible=True):
     """One sweep point, reusing run_baselines.run_config (the shared
     denoise train-step harness) so probe numbers stay comparable with
     the baseline table."""
@@ -34,7 +33,7 @@ def probe_point(dim, chunks, fast, steps, n=1024, k=32):
     name = 'flagship_fast' if fast else 'flagship'
     module = recipes.RECIPES[name](
         dim=dim, num_neighbors=k, output_degrees=2, reduce_dim_out=True,
-        edge_chunks=(chunks if chunks > 0 else None))
+        edge_chunks=(chunks if chunks > 0 else None), reversible=reversible)
     rec = run_baselines.run_config(f'{name}-probe', module, n, steps,
                                    np.random.RandomState(0))
     return dict(step_ms=rec['step_ms'], compile_s=rec['compile_s'],
@@ -61,24 +60,38 @@ def main(argv=None):
     backend = jax.default_backend()
     print(f'backend: {backend}', flush=True)
 
+    # tunnel-death signatures: such failures must PROPAGATE so
+    # tpu_session's retryable-exit detection fires — recording them as
+    # fits=False would both corrupt the table and end the session loop
+    tunnel_sigs = ('unavailable', 'broken pipe', 'network error',
+                   'connection refused', 'remote_compile')
+
+    def run_and_record(**pt):
+        rec = dict(pt)
+        rec['backend'] = backend
+        try:
+            rec.update(probe_point(pt['dim'], pt['edge_chunks'], args.fast,
+                                   args.steps, n=args.nodes,
+                                   reversible=pt.get('reversible', True)))
+            rec['fits'] = True
+        except Exception as e:  # noqa: BLE001
+            msg = f'{type(e).__name__}: {e}'
+            if any(s in msg.lower() for s in tunnel_sigs):
+                raise  # retryable infrastructure failure, not a fit result
+            rec['fits'] = False
+            rec['error'] = msg[:220]
+        print(json.dumps(rec), flush=True)
+        with open(args.out, 'a') as f:
+            f.write(json.dumps(rec) + '\n')
+        return rec
+
     # cheapest-first so early tunnel deaths still leave a table; dims
     # outer (a width that OOMs at chunks=8 is skipped at lower chunks)
     for dim in args.dims:
         dim_fits = False
         for chunks in sorted(args.chunks, reverse=True):  # more chunks first
-            rec = dict(dim=dim, edge_chunks=chunks, fast=args.fast,
-                       backend=backend)
-            try:
-                rec.update(probe_point(dim, chunks, args.fast, args.steps,
-                                       n=args.nodes))
-                rec['fits'] = True
-                dim_fits = True
-            except Exception as e:  # noqa: BLE001 - OOM or tunnel death
-                rec['fits'] = False
-                rec['error'] = f'{type(e).__name__}: {str(e)[:200]}'
-            print(json.dumps(rec), flush=True)
-            with open(args.out, 'a') as f:
-                f.write(json.dumps(rec) + '\n')
+            rec = run_and_record(dim=dim, edge_chunks=chunks, fast=args.fast)
+            dim_fits = dim_fits or rec['fits']
             if not rec['fits']:
                 # fewer chunks only use MORE memory: once this dim fails
                 # at the most-chunked setting, lower settings are doomed
@@ -86,6 +99,12 @@ def main(argv=None):
                 print(f'dim={dim}: skipping lower chunk settings after '
                       f'failure at edge_chunks={chunks}', flush=True)
                 break
+            if chunks == 0:
+                # unchunked fit: also measure without the reversible
+                # remat (the recompute costs ~one extra forward per
+                # step) — the highest-memory, fastest-possible point
+                run_and_record(dim=dim, edge_chunks=0, reversible=False,
+                               fast=args.fast)
         if not dim_fits:
             print(f'dim={dim} fits at no chunk setting; stopping sweep',
                   flush=True)

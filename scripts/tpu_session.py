@@ -61,6 +61,18 @@ def main():
     log(f'compilation cache: {enable_compilation_cache()}')
 
     failed = False
+    tunnel_died = [False]
+
+    def note_failure(tb: str):
+        # a mid-session tunnel death (the chip lease is gone, compiles
+        # fail UNAVAILABLE / broken pipe) is RETRYABLE from a fresh
+        # process — exit 3 so the session loop relaunches, instead of
+        # rc=2 which ends the loop with stages uncollected
+        low = tb.lower()
+        if any(sig in low for sig in ('unavailable', 'broken pipe',
+                                      'network error', 'connection refused',
+                                      'remote_compile')):
+            tunnel_died[0] = True
 
     log('--- kernel_smoke (Mosaic lowering + numerics) ---')
     try:
@@ -73,7 +85,13 @@ def main():
             log('kernel_smoke: all pass')
     except Exception:
         failed = True
-        log('kernel_smoke FAILED:\n' + traceback.format_exc())
+        tb = traceback.format_exc()
+        note_failure(tb)
+        log('kernel_smoke FAILED:\n' + tb)
+
+    if tunnel_died[0]:
+        log('tunnel died; abandoning remaining stages (retryable)')
+        return 3
 
     import bench
 
@@ -98,7 +116,13 @@ def main():
         save_bench(rec)
     except Exception:
         failed = True
-        log('bench FAILED:\n' + traceback.format_exc())
+        tb = traceback.format_exc()
+        note_failure(tb)
+        log('bench FAILED:\n' + tb)
+
+    if tunnel_died[0]:
+        log('tunnel died; abandoning remaining stages (retryable)')
+        return 3
 
     log('--- flagship bench (fast: shared radial + fuse_basis + bf16) ---')
     try:
@@ -107,7 +131,13 @@ def main():
         save_bench(rec)
     except Exception:
         failed = True
-        log('bench fast FAILED:\n' + traceback.format_exc())
+        tb = traceback.format_exc()
+        note_failure(tb)
+        log('bench fast FAILED:\n' + tb)
+
+    if tunnel_died[0]:
+        log('tunnel died; abandoning remaining stages (retryable)')
+        return 3
 
     log('--- tpu_checks ---')
     try:
@@ -116,7 +146,13 @@ def main():
         log('tpu_checks: completed')
     except Exception:
         failed = True
-        log('tpu_checks FAILED:\n' + traceback.format_exc())
+        tb = traceback.format_exc()
+        note_failure(tb)
+        log('tpu_checks FAILED:\n' + tb)
+
+    if tunnel_died[0]:
+        log('tunnel died; abandoning remaining stages (retryable)')
+        return 3
 
     log('--- stage timings (flagship bench config) ---')
     try:
@@ -125,7 +161,13 @@ def main():
         log(f'stage_timings: {rep["stage_ms"]}')
     except Exception:
         failed = True
-        log('stage_timings FAILED:\n' + traceback.format_exc())
+        tb = traceback.format_exc()
+        note_failure(tb)
+        log('stage_timings FAILED:\n' + tb)
+
+    if tunnel_died[0]:
+        log('tunnel died; abandoning remaining stages (retryable)')
+        return 3
 
     log('--- baseline configs ---')
     try:
@@ -135,7 +177,13 @@ def main():
         log(f'run_baselines: completed ({out_path})')
     except Exception:
         failed = True
-        log('run_baselines FAILED:\n' + traceback.format_exc())
+        tb = traceback.format_exc()
+        note_failure(tb)
+        log('run_baselines FAILED:\n' + tb)
+
+    if tunnel_died[0]:
+        log('tunnel died; abandoning remaining stages (retryable)')
+        return 3
 
     log('--- knob/width probe (edge_chunks x dim) ---')
     try:
@@ -144,7 +192,13 @@ def main():
         log('tpu_probe: completed (PROBE_TPU.jsonl)')
     except Exception:
         failed = True
-        log('tpu_probe FAILED:\n' + traceback.format_exc())
+        tb = traceback.format_exc()
+        note_failure(tb)
+        log('tpu_probe FAILED:\n' + tb)
+
+    if tunnel_died[0]:
+        log('tunnel died; abandoning remaining stages (retryable)')
+        return 3
 
     log('--- flagship profile ---')
     try:
@@ -169,6 +223,9 @@ def main():
     except Exception:
         log('profile FAILED (non-fatal):\n' + traceback.format_exc())
 
+    if tunnel_died[0]:
+        log('session lost the tunnel mid-way, releasing chip (retryable)')
+        return 3
     log(f'session done ({"FAILED" if failed else "ok"}), releasing chip')
     return 2 if failed else 0
 

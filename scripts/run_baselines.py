@@ -22,7 +22,7 @@ def node_counts():
                 af2_refinement=256, molecular_edges=128, egnn_stress=512)
 
 
-def run_config(name, module, n, steps, rng):
+def run_config(name, module, n, steps, rng, batch=1):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -30,7 +30,7 @@ def run_config(name, module, n, steps, rng):
 
     needs_adj = bool(module.attend_sparse_neighbors or module.num_adj_degrees)
     has_tokens = module.num_tokens is not None
-    b = 1
+    b = batch
 
     if has_tokens:
         feats = jnp.asarray(rng.randint(0, module.num_tokens, (b, n)))

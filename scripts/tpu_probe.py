@@ -12,7 +12,7 @@ program may fit outright, and fewer chunks mean less lax.map overhead
 also produces the max-width-per-chip table VERDICT r2 #2 asked for.
 
 Usage: python scripts/tpu_probe.py [--out PROBE.jsonl] [--steps 3]
-       [--fast] [--dims 64 96 128] [--chunks 0 2 8]
+       [--fast] [--dims 64 96 128] [--chunks 0 2 8] [--batches 2 4]
 """
 import argparse
 import json
@@ -22,7 +22,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def probe_point(dim, chunks, fast, steps, n=1024, k=32, reversible=True):
+def probe_point(dim, chunks, fast, steps, n=1024, k=32, reversible=True,
+                batch=1):
     """One sweep point, reusing run_baselines.run_config (the shared
     denoise train-step harness) so probe numbers stay comparable with
     the baseline table."""
@@ -35,7 +36,7 @@ def probe_point(dim, chunks, fast, steps, n=1024, k=32, reversible=True):
         dim=dim, num_neighbors=k, output_degrees=2, reduce_dim_out=True,
         edge_chunks=(chunks if chunks > 0 else None), reversible=reversible)
     rec = run_baselines.run_config(f'{name}-probe', module, n, steps,
-                                   np.random.RandomState(0))
+                                   np.random.RandomState(0), batch=batch)
     return dict(step_ms=rec['step_ms'], compile_s=rec['compile_s'],
                 nodes_steps_per_sec=rec['nodes_steps_per_sec'])
 
@@ -50,6 +51,7 @@ def main(argv=None):
     ap.add_argument('--dims', type=int, nargs='+', default=[64, 96, 128])
     ap.add_argument('--chunks', type=int, nargs='+', default=[0, 2, 8])
     ap.add_argument('--nodes', type=int, default=1024)
+    ap.add_argument('--batches', type=int, nargs='+', default=[2, 4])
     args = ap.parse_args(argv)
 
     import jax
@@ -72,7 +74,8 @@ def main(argv=None):
         try:
             rec.update(probe_point(pt['dim'], pt['edge_chunks'], args.fast,
                                    args.steps, n=args.nodes,
-                                   reversible=pt.get('reversible', True)))
+                                   reversible=pt.get('reversible', True),
+                                   batch=pt.get('batch', 1)))
             rec['fits'] = True
         except Exception as e:  # noqa: BLE001
             msg = f'{type(e).__name__}: {e}'
@@ -105,6 +108,16 @@ def main(argv=None):
                 # step) — the highest-memory, fastest-possible point
                 run_and_record(dim=dim, edge_chunks=0, reversible=False,
                                fast=args.fast)
+        if dim_fits and dim == args.dims[0]:
+            # per-chip throughput scales with batch while HBM lasts (the
+            # reference's own training runs 16 accumulated micro-batches,
+            # denoise.py:13,55) — measure the batch ceiling at the
+            # primary width using the most memory-lean chunk setting
+            for b in sorted(args.batches):
+                rec = run_and_record(dim=dim, edge_chunks=max(args.chunks),
+                                     batch=b, fast=args.fast)
+                if not rec['fits']:
+                    break
         if not dim_fits:
             print(f'dim={dim} fits at no chunk setting; stopping sweep',
                   flush=True)

@@ -49,6 +49,25 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _block_overrides(*names):
+    """Forward block-size env overrides for tuning sweeps
+    (scripts/kernel_tune.py): SE3_TPU_BLOCK_E paired with
+    SE3_TPU_BLOCK_IF (plain) / SE3_TPU_BLOCK_CB (bx). BOTH variables of
+    a pair must be set — a lone one warns and is ignored. Read per call;
+    the sweep runs one subprocess per setting because the jit cache keys
+    on shapes/statics, not env. Backward kernels never use overrides
+    (their working set is ~2x the forward's)."""
+    import os
+    vals = [os.environ.get(n, '') for n in names]
+    if all(vals):
+        return tuple(int(v) for v in vals)
+    if any(vals):
+        import warnings
+        warnings.warn(f'block override ignored: {names} must ALL be set '
+                      f'(got {vals})', stacklevel=2)
+    return None
+
+
 def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
                  vmem_budget: int = 6 * 2 ** 20,
                  max_unroll: int = 256, bwd: bool = False):
@@ -59,6 +78,11 @@ def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
     Mosaic block-shape rule: every blocked dim must either cover the full
     array or be divisible by its tile quantum — so block_if is the full IF
     (n_if == 1) or a multiple of 8, and block_e a multiple of 128."""
+    if not bwd:  # sweeps time the forward; the bwd working set is ~2x,
+        # so overrides never bypass the bwd VMEM model
+        ov = _block_overrides('SE3_TPU_BLOCK_E', 'SE3_TPU_BLOCK_IF')
+        if ov:
+            return ov[0], min(IF, ov[1])
     e_cap = _round_up(E, 128)
     for block_e in (512, 256, 128):
         if block_e > e_cap:
@@ -360,6 +384,9 @@ def _pick_blocks_bx(E: int, C: int, O: int, P: int, Q: int, F: int,
     """(block_e, cb) for the basis-fused kernel. cb is the c-chunk: a
     multiple of 8 (so the xt row-block cb*Q and w3t row-block cb*F*O are
     tile-aligned for any odd Q/F) or the full (padded) C."""
+    ov = _block_overrides('SE3_TPU_BLOCK_E', 'SE3_TPU_BLOCK_CB')
+    if ov:
+        return ov
     for block_e in (512, 256, 128):
         if block_e > _round_up(E, 128):
             continue

@@ -2,8 +2,26 @@
 # Relaunch tpu_session until it actually gets the chip (rc!=3) — the
 # tunnel alternates between blocking (session waits inside) and failing
 # init outright (rc=3, needs a fresh process).
+#
+# CLEAN-SHUTDOWN RULE (VERDICT r3 next #1c): the loop must leave NO
+# claim-holding process behind when the builder's round ends, or the
+# driver's own bench.py probe wedges on the single-client tunnel.
+# `touch /root/repo/.tpu_stop` stops the loop at the next relaunch
+# boundary (never mid-session: a running session finishes and releases
+# the chip itself; only blocked WAITERS are safe to kill).
 cd /root/repo
+STOP=/root/repo/.tpu_stop
+# a stop file only ever means "stop the CURRENTLY running loop" — a
+# stale one from a previous round must not disable this launch.
+# (Known, accepted race: a stop touched in the seconds between launch
+# and this rm is erased. Protocol: never touch .tpu_stop while also
+# launching — see tpu_supervisor.sh header.)
+rm -f "$STOP"
 while true; do
+  if [ -e "$STOP" ]; then
+    echo "[loop] stop file present, exiting cleanly $(date -u +%H:%M:%S)" >> /tmp/tpu_session_r2.log
+    exit 0
+  fi
   python scripts/tpu_session.py /tmp/tpu_session_r2.log
   rc=$?
   echo "[loop] session rc=$rc at $(date -u +%H:%M:%S)" >> /tmp/tpu_session_r2.log

@@ -5,6 +5,12 @@
 # one completes with the chip. Log file is the loop's hardcoded
 # /tmp/tpu_session_r2.log (keep in sync with tpu_session_loop.sh).
 cd /root/repo || exit 1
+# STOP-FILE PROTOCOL: .tpu_stop means "shut down the running loop NOW".
+# Whoever intentionally STARTS a loop or supervisor clears any stale
+# stop first (a leftover from last round must not disable this launch);
+# the checks further down react only to a stop that appears WHILE we
+# run. Don't touch the stop file in the same breath as launching.
+rm -f /root/repo/.tpu_stop
 # single-instance lock: two supervisors waking together would exec two
 # session loops and race for the single-client tunnel
 LOCK=/tmp/tpu_supervisor.lock
@@ -20,10 +26,23 @@ LOG=/tmp/tpu_session_r2.log
 # rotation during the wait (ADVICE r2 #4)
 MARK="supervisor-epoch-$$-$(date -u +%s)"
 echo "[supervisor] $MARK waiting" >> "$LOG"
+# .tpu_stop is the round-end clean-shutdown signal (see
+# tpu_session_loop.sh): the supervisor must honor it too, or a
+# stop-triggered loop exit would just get relaunched here — and the
+# relaunched loop's startup rm -f would erase the stop signal
+STOP=/root/repo/.tpu_stop
 while pgrep -f "scripts/tpu_session.py" > /dev/null \
     || pgrep -f "tpu_session_loop.sh" > /dev/null; do
+  if [ -e "$STOP" ]; then
+    echo "[supervisor] stop file present, exiting without relaunch" >> "$LOG"
+    exit 0
+  fi
   sleep 60
 done
+if [ -e "$STOP" ]; then
+  echo "[supervisor] stop file present, exiting without relaunch" >> "$LOG"
+  exit 0
+fi
 if awk -v m="$MARK" 'index($0, m) {found=1}
                      found && /session done \(ok\)/ {ok=1}
                      END {exit !ok}' "$LOG" 2>/dev/null; then

@@ -269,7 +269,10 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
         except Exception as e:  # noqa: BLE001
             print(f'equivariance check failed ({type(e).__name__}); '
                   f'recording throughput without it', file=sys.stderr)
-    elif eq_env not in ('0', 'false', 'no', 'off'):
+    elif on_chip and eq_env not in ('0', 'false', 'no', 'off'):
+        # on_chip guard: the twin belongs to the flagship branch only —
+        # a cpu-probed run that nonetheless finds an accelerator in
+        # process measured the TOY workload, and recipe_name is unset
         try:
             twin = recipes.RECIPES[recipe_name](
                 dim=16, depth=2, num_neighbors=8, output_degrees=2,

@@ -94,6 +94,17 @@ def main():
         out = fused_pairwise_conv_bx(h, w3, bas, x, precision='highest')
         ok &= check(f'pairwise bx fwd E={E} C={C} Q={Q} F={F}', out, ref)
 
+        # flat-basis twin (bxf): the layout the flagship fast path now
+        # feeds — same math through a [E, P*F*Q] operand (Mosaic must
+        # lower the 2D-transposed bt identically)
+        from se3_transformer_tpu.kernels.pallas_pairwise import (
+            fused_pairwise_conv_bxf,
+        )
+        flat = jnp.swapaxes(bas, -1, -2).reshape(E, P * F * Q)
+        outf = fused_pairwise_conv_bxf(h, w3, flat, x, (P, Q, F),
+                                       precision='highest')
+        ok &= check(f'pairwise bxf fwd E={E} C={C} Q={Q} F={F}', outf, ref)
+
     # --- MXU one-hot gather vs jnp.take at a flagship-shaped gather:
     # the auto heuristic only fires on TPU, so CPU tests never see the
     # on-chip numerics of the matmul path ---
@@ -110,9 +121,10 @@ def main():
                     tol=1e-6)
     else:
         # run-everything contract: never abort the remaining canaries
+        from se3_transformer_tpu.utils.helpers import is_tpu_backend
         print('onehot gather heuristic OFF at flagship shape '
               f'(backend={jax.default_backend()}) [FAIL]')
-        ok &= jax.default_backend() != 'tpu'
+        ok &= not is_tpu_backend()
 
     # --- attention kernel ---
     from se3_transformer_tpu.kernels.pallas_attention import (

@@ -92,13 +92,17 @@ def bench_conv(pallas: bool, n=512, k=24, dim=32, degrees=3, iters=10,
                    radial_bf16=radial_bf16)
 
     # jit the input prep: eager gathers/basis would round-trip thousands of
-    # tiny ops through the device tunnel (minutes of latency)
+    # tiny ops through the device tunnel (minutes of latency). fuse_basis
+    # measures the FLAT basis layout — what the model actually feeds the
+    # bx kernel since round 4 (docs/DESIGN.md §2a)
+    layout = 'pfq_flat' if fuse_basis else 'pqf'
+
     @jax.jit
     def prep(coors):
         coors_j = batched_index_select(coors, idx, axis=1)
         rel_pos = coors[:, :, None, :] - coors_j
         rel_dist = jnp.linalg.norm(rel_pos, axis=-1)
-        basis = get_basis(rel_pos, degrees - 1)
+        basis = get_basis(rel_pos, degrees - 1, layout=layout)
         return rel_dist, basis
 
     rel_dist, basis = prep(coors)
@@ -169,12 +173,14 @@ def check_fused_backward(n=256, k=16, dim=24, degrees=3,
     return worst
 
 
-def bench_attention(fused: bool, B=1, h=8, n=1024, J=33, D=56, iters=20):
-    """Fused attention kernel vs the XLA einsum path at the flagship's
-    largest PER-DEGREE shape (degree 3: D = dim_head*(2*3+1) = 8*7 = 56;
-    J = k+1 kv slots) — the model dispatches one kernel per degree."""
+def bench_attention(variant: str, B=1, h=8, n=1024, J=33, D=56, iters=20):
+    """Attention path comparison at a flagship per-degree shape
+    (D = dim_head*(2*deg+1) with dim_head=8 -> 8/24/40/56; J = k+1 kv
+    slots) — the model dispatches one kernel per degree. Variants:
+    'xla' einsum path, 'fused' D-on-lanes kernel, 'jt' J-on-lanes
+    layout experiment (VERDICT r3 next #6)."""
     from se3_transformer_tpu.kernels.pallas_attention import (
-        attention_reference, fused_attention,
+        attention_reference, fused_attention, fused_attention_jt,
     )
     rng = np.random.RandomState(0)
     q = jnp.asarray(rng.normal(size=(B * h, n, D)), jnp.float32)
@@ -184,10 +190,12 @@ def bench_attention(fused: bool, B=1, h=8, n=1024, J=33, D=56, iters=20):
     mask = mask.at[:, :, 0].set(True)
     scale = D ** -0.5
 
-    if fused:
-        fn = jax.jit(lambda q, k, v: fused_attention(q, k, v, mask, h, scale))
-    else:
-        fn = jax.jit(lambda q, k, v: attention_reference(q, k, v, mask, scale))
+    impl = dict(
+        xla=lambda q, k, v: attention_reference(q, k, v, mask, scale),
+        fused=lambda q, k, v: fused_attention(q, k, v, mask, h, scale),
+        jt=lambda q, k, v: fused_attention_jt(q, k, v, mask, h, scale),
+    )[variant]
+    fn = jax.jit(impl)
     out = jax.block_until_ready(fn(q, k, v))
     t0 = time.time()
     for _ in range(iters):
@@ -245,12 +253,22 @@ def main():
           f'({t_xla/t_rb:.2f}x vs xla), rel diff={diff:.2e} '
           f'[{"PASS" if diff < 3e-2 else "FAIL"}]')
 
-    t_ax, out_ax = bench_attention(fused=False)
-    t_af, out_af = bench_attention(fused=True)
-    adiff = float(jnp.abs(out_ax - out_af).max())
-    print(f'attention fwd: xla {t_ax*1e3:.2f} ms, fused {t_af*1e3:.2f} ms '
-          f'({t_ax/t_af:.2f}x), max|diff|={adiff:.2e} '
-          f'[{"PASS" if adiff < 1e-3 else "FAIL"}]')
+    # attention layout decision table (VERDICT r3 next #6): every
+    # flagship per-degree shape, all three paths. The model runs one
+    # attention per degree, so the layout verdict needs the small-D
+    # shapes where D-on-lanes wastes 16x lane width — not just D=56.
+    for D in (8, 24, 40, 56):
+        t_ax, out_ax = bench_attention('xla', D=D)
+        t_af, out_af = bench_attention('fused', D=D)
+        t_jt, out_jt = bench_attention('jt', D=D)
+        adiff = float(jnp.abs(out_ax - out_af).max())
+        jdiff = float(jnp.abs(out_ax - out_jt).max())
+        ok = adiff < 1e-3 and jdiff < 1e-3
+        print(f'attention fwd D={D}: xla {t_ax*1e3:.2f} ms, '
+              f'fused(D-lanes) {t_af*1e3:.2f} ms ({t_ax/t_af:.2f}x), '
+              f'jt(J-lanes) {t_jt*1e3:.2f} ms ({t_ax/t_jt:.2f}x), '
+              f'max|diff| fused={adiff:.2e} jt={jdiff:.2e} '
+              f'[{"PASS" if ok else "FAIL"}]')
 
 
 if __name__ == '__main__':

@@ -155,8 +155,12 @@ def main():
             fn()
         except Exception:
             tb = traceback.format_exc()
-            note_failure(tb)
             if fatal:
+                # classify tunnel deaths only for fatal stages: a death
+                # in a trailing non-fatal stage (profile) must NOT turn
+                # a session whose deliverables are already saved into an
+                # rc=3 full relaunch
+                note_failure(tb)
                 failed[0] = True
             log(f'{title} FAILED{"" if fatal else " (non-fatal)"}:\n' + tb)
         if tunnel_died[0]:

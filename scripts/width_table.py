@@ -1,0 +1,203 @@
+"""Max-width-per-chip-count table: flagship memory vs (dim, devices).
+
+VERDICT r3 next #4: BASELINE.md's tracked flagship label is
+SE3Transformer(dim=512, depth=6, num_degrees=4) at 1024 nodes, but
+nothing had ever instantiated dim>=128 — the multi-chip memory story was
+untested theory. This harness compiles the FULL sharded training step
+(sp-sharded nodes + tp-sharded radial weights + edge_chunks, the same
+program dryrun_multichip validates) at the label shape n=1024/k=32 over
+an N-virtual-CPU-device mesh and records XLA's per-shard memory analysis
+(SPMD emits one per-device program, so temp+argument sizes ARE the
+per-chip footprint estimate). Optionally executes one step at a reduced
+node count to prove the label-width program actually runs end to end.
+
+The numbers are XLA:CPU SPMD estimates — layouts/fusion differ from TPU
+(measured on-chip: dim=64 needs the remat recipe to fit 16 GB, which
+matches this harness's estimate within ~20%) — so the table is stated
+as the scaling story, with the dim=64 single-chip point anchored by the
+real-HBM measurements in docs/STATUS.md.
+
+Usage (fresh process per device count — the virtual device count is
+fixed at backend init):
+    python scripts/width_table.py --devices 8 --dims 512 [--exec-dim 512]
+    python scripts/width_table.py --devices 1 --dims 64 128
+Writes crash-safe JSONL to WIDTH_TABLE.jsonl (append).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def _setup(n_devices: int):
+    flags = os.environ.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in flags:
+        os.environ['XLA_FLAGS'] = (
+            flags + f' --xla_force_host_platform_device_count={n_devices}'
+        ).strip()
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    return jax
+
+
+def _flagship_step(jax, mesh, dim, n, k, tp, compile_only=True):
+    """Lower + compile the exact bench.py training program (flagship_fast
+    recipe, denoise objective, adam) over the mesh; returns (compiled,
+    compile_s, example_args)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from se3_transformer_tpu.parallel.sharding import (
+        make_sharded_train_step, shard_params,
+    )
+    from se3_transformer_tpu.training import recipes
+
+    module = recipes.RECIPES['flagship_fast'](
+        dim=dim, num_neighbors=k, output_degrees=2, reduce_dim_out=True)
+
+    rng = np.random.RandomState(0)
+    feats = jnp.asarray(rng.normal(size=(1, n, dim)), jnp.float32)
+    coords = jnp.asarray(
+        np.cumsum(rng.normal(size=(1, n, 3)), axis=1), jnp.float32)
+    masks = jnp.ones((1, n), bool)
+
+    def loss_fn(params, data, key):
+        noise = jax.random.normal(key, data['coords'].shape,
+                                  data['coords'].dtype)
+        noised = data['coords'] + noise
+        out = module.apply({'params': params}, data['seqs'], noised,
+                           mask=data['masks'], return_type=1)
+        loss = (((noised + out) - data['coords']) ** 2).sum(-1).mean()
+        return loss, dict()
+
+    # init with abstract eval only — a real init at dim=512 would
+    # EXECUTE the forward on CPU (minutes to hours); eval_shape gives the
+    # param tree structure for lowering, and zeros fill it for execution
+    init_shapes = jax.eval_shape(
+        lambda key: module.init(key, feats, coords, mask=masks,
+                                return_type=1),
+        jax.random.PRNGKey(0))['params']
+    params = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), init_shapes)
+    params = shard_params(params, mesh)
+    optimizer = optax.adam(1e-4)
+    opt_state = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(optimizer.init, params))
+    opt_state = jax.tree_util.tree_map(
+        lambda v: jax.device_put(v, NamedSharding(mesh, P())), opt_state)
+
+    step = make_sharded_train_step(loss_fn, optimizer, mesh=mesh,
+                                   donate=False, tensor_parallel=(tp > 1))
+
+    node_spec = P(None, 'sp', None)
+    data = dict(
+        seqs=jax.device_put(feats, NamedSharding(mesh, node_spec)),
+        coords=jax.device_put(coords, NamedSharding(mesh, node_spec)),
+        masks=jax.device_put(masks, NamedSharding(mesh, P(None, 'sp'))))
+    key = jax.random.PRNGKey(1)
+
+    t0 = time.time()
+    compiled = step.lower(params, opt_state, data, key).compile()
+    compile_s = time.time() - t0
+    return compiled, compile_s, (params, opt_state, data, key)
+
+
+def measure_point(jax, mesh, dim, n, k, tp, execute=False):
+    compiled, compile_s, args = _flagship_step(jax, mesh, dim, n, k, tp)
+    rec = dict(dim=dim, n=n, k=k, compile_s=round(compile_s, 1))
+    try:
+        ma = compiled.memory_analysis()
+        if isinstance(ma, (list, tuple)):
+            ma = ma[0]
+        for field in ('temp_size_in_bytes', 'argument_size_in_bytes',
+                      'output_size_in_bytes', 'alias_size_in_bytes',
+                      'generated_code_size_in_bytes'):
+            v = getattr(ma, field, None)
+            if v is not None:
+                rec[field.replace('_in_bytes', '_mb')] = round(v / 2**20, 1)
+        temp = getattr(ma, 'temp_size_in_bytes', 0) or 0
+        arg = getattr(ma, 'argument_size_in_bytes', 0) or 0
+        # per-shard footprint estimate: live temporaries + resident
+        # arguments (params+opt state+batch shard). alias'd buffers are
+        # counted inside argument size already.
+        rec['per_shard_total_gb'] = round((temp + arg) / 2**30, 3)
+    except Exception as e:  # noqa: BLE001 - memory analysis best-effort
+        rec['memory_analysis_error'] = f'{type(e).__name__}: {e}'[:200]
+    if execute:
+        t0 = time.time()
+        params, opt_state, data, key = args
+        out = compiled(params, opt_state, data, key)
+        jax.block_until_ready(out[2])
+        rec['exec_step_s'] = round(time.time() - t0, 1)
+        rec['loss_finite'] = bool(jax.numpy.isfinite(out[2]))
+    return rec
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--devices', type=int, required=True)
+    ap.add_argument('--dims', type=int, nargs='*', default=[],
+                    help='label-shape (n=1024) compile+memory points. '
+                         'CAUTION: XLA:CPU memory analysis measured ~4x '
+                         'over the real TPU footprint (dim=64/8dev said '
+                         '32.6 GB/shard vs <16 GB measured on one whole '
+                         'chip) — treat as an upper bound only')
+    ap.add_argument('--nodes', type=int, default=1024)
+    ap.add_argument('--k', type=int, default=32)
+    ap.add_argument('--dp', type=int, default=1)
+    ap.add_argument('--tp', type=int, default=None,
+                    help='tp axis size (default 2 when devices%%2==0)')
+    ap.add_argument('--exec-dim', type=int, default=None,
+                    help='also EXECUTE one step at this dim (reduced '
+                         'nodes, see --exec-nodes)')
+    ap.add_argument('--exec-nodes', type=int, default=128)
+    ap.add_argument('--out', default=os.path.join(REPO, 'WIDTH_TABLE.jsonl'))
+    args = ap.parse_args(argv)
+
+    jax = _setup(args.devices)
+    from se3_transformer_tpu.parallel.mesh import make_mesh
+    devices = jax.devices()[:args.devices]
+    assert len(devices) >= args.devices, \
+        f'only {len(devices)} devices visible'
+    tp = args.tp if args.tp is not None else (
+        2 if args.devices % 2 == 0 else 1)
+    mesh = make_mesh(devices, dp=args.dp, tp=tp)
+    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    print(f'mesh: {mesh_shape}', flush=True)
+
+    for dim in args.dims:
+        rec = dict(devices=args.devices, mesh=mesh_shape, backend='cpu-spmd')
+        try:
+            rec.update(measure_point(jax, mesh, dim, args.nodes, args.k, tp))
+        except Exception as e:  # noqa: BLE001 - keep sweeping
+            rec.update(dim=dim, n=args.nodes, k=args.k,
+                       error=f'{type(e).__name__}: {e}'[:300])
+        print(json.dumps(rec), flush=True)
+        with open(args.out, 'a') as f:
+            f.write(json.dumps(rec) + '\n')
+
+    if args.exec_dim:
+        rec = dict(devices=args.devices, mesh=mesh_shape,
+                   backend='cpu-spmd', executed=True)
+        try:
+            rec.update(measure_point(jax, mesh, args.exec_dim,
+                                     args.exec_nodes, min(args.k, 16), tp,
+                                     execute=True))
+        except Exception as e:  # noqa: BLE001
+            rec.update(dim=args.exec_dim, n=args.exec_nodes,
+                       error=f'{type(e).__name__}: {e}'[:300])
+        print(json.dumps(rec), flush=True)
+        with open(args.out, 'a') as f:
+            f.write(json.dumps(rec) + '\n')
+
+
+if __name__ == '__main__':
+    main()

@@ -178,12 +178,22 @@ def safe_normalize(vec: jnp.ndarray, eps: float = 1e-8):
 
 
 def get_basis(rel_pos: jnp.ndarray, max_degree: int,
-              differentiable: bool = False) -> dict:
+              differentiable: bool = False, layout: str = 'pqf') -> dict:
     """Pairwise equivariant kernel bases for all degree pairs.
 
     rel_pos: [..., 3] relative offsets (need not be normalized).
-    Returns {f'{d_in},{d_out}': [..., 2*d_out+1, 2*d_in+1, n_freq]} for all
-    d_in, d_out in 0..max_degree (reference basis.py:153-205).
+    layout='pqf' (default): {f'{d_in},{d_out}':
+    [..., 2*d_out+1, 2*d_in+1, n_freq]} for all d_in, d_out in
+    0..max_degree (reference basis.py:153-205).
+
+    layout='pfq_flat': the same values flattened per edge to
+    [..., P*F*Q] in (p, f, q) order — the TPU hot-path layout. The
+    structured form puts two small odd axes (Q, F) in the tile-padded
+    minor positions, inflating the materialized HBM buffers up to ~60x
+    at num_degrees=4 ((Q,F)=(7,7) pads to (8,128)); one flat minor axis
+    pads only to the next 128 multiple (~1.1x), and (p,f,q) is exactly
+    the order the fused bx kernel's [P*F*Q, E] operand wants, so the
+    relayout into the kernel is a plain 2D transpose.
     """
     rhat, _ = safe_normalize(rel_pos)
     Ys = real_spherical_harmonics_all(2 * max_degree, rhat, xp=jnp)
@@ -200,7 +210,13 @@ def get_basis(rel_pos: jnp.ndarray, max_degree: int,
                                 precision=jax.lax.Precision.HIGHEST)
             Ks.append(K_flat.reshape(*K_flat.shape[:-1],
                                      2 * d_out + 1, 2 * d_in + 1))
-        out[f'{d_in},{d_out}'] = jnp.stack(Ks, axis=-1)
+        if layout == 'pfq_flat':
+            k = jnp.stack(Ks, axis=-2)              # [..., P, F, Q]
+            out[f'{d_in},{d_out}'] = k.reshape(*k.shape[:-3], -1)
+        elif layout == 'pqf':
+            out[f'{d_in},{d_out}'] = jnp.stack(Ks, axis=-1)
+        else:
+            raise ValueError(f'unknown basis layout {layout!r}')
 
     if not differentiable:
         out = jax.tree_util.tree_map(jax.lax.stop_gradient, out)

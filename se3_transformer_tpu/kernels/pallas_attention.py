@@ -133,7 +133,14 @@ def fused_attention_fits(J: int, D: int, bwd: bool = True) -> bool:
     """True when the fused kernel's working set fits the scoped-VMEM
     budget at SOME block size. The dispatch in ops.attention falls back
     to the XLA path when this is False (e.g. num_neighbors~512 at a wide
-    dim_head) instead of surfacing a Mosaic VMEM error."""
+    dim_head) instead of surfacing a Mosaic VMEM error.
+
+    bwd=True is DELIBERATELY conservative (ADVICE r3 #2): the module
+    dispatch cannot know whether the caller will differentiate, so it
+    budgets for the ~2x backward working set even in inference-only use.
+    A config whose forward fits but backward doesn't therefore runs XLA;
+    callers that never differentiate can query fits(bwd=False) and call
+    kernels.pallas_attention.fused_attention directly."""
     return 8 * _block_row_bytes(J, D, bwd) <= _VMEM_LIMIT
 
 
@@ -420,6 +427,114 @@ def _att_partitioned(heads, scale, interpret, has_mask, bwd):
                     sharding_rule=rule,
                     need_replication_factors=('d', 'j'))
     return f
+
+
+# --------------------------------------------------------------------- #
+# J-on-lanes layout experiment (VERDICT r3 next #6)
+# --------------------------------------------------------------------- #
+# The production kernel above blocks k/v as [n_b, J, D] — D on lanes —
+# which pads the flagship's smallest per-degree feature axis D=8 to 128
+# lanes (16x wasted VPU width; J=33 pads only to 40 sublanes). This
+# variant transposes to [n_b, D, J]: J on lanes pads 33 -> 128 (3.9x)
+# while D sits on sublanes (8/24/40/56 all pad to the 8-quantum
+# exactly), shrinking the kv VMEM block 5x at D=8 and making sim land
+# J-on-lanes for the softmax. Forward-only: it exists to measure the
+# layout question on chip (scripts/tpu_checks.py benches both at every
+# flagship degree shape); whichever loses is deleted, per the
+# data-or-retire rule.
+
+
+def _kernel_jt(q_ref, kt_ref, vt_ref, mask_ref, o_ref, *, scale):
+    q = q_ref[0]             # [n_b, D]
+    kt = kt_ref[0]           # [n_b, D, J]
+    vt = vt_ref[0]           # [n_b, D, J]
+    sim = jnp.sum(kt * q[:, :, None], axis=1) * scale      # [n_b, J]
+    sim = jnp.where(mask_ref[0], sim, NEG_INF)
+    m = jnp.max(sim, axis=-1, keepdims=True)
+    p = jnp.exp(sim - m)
+    attn = p / jnp.sum(p, axis=-1, keepdims=True)
+    o_ref[0] = jnp.sum(vt * attn[:, None, :], axis=-1).astype(o_ref.dtype)
+
+
+def _kernel_jt_nomask(q_ref, kt_ref, vt_ref, o_ref, *, scale):
+    q = q_ref[0]
+    kt = kt_ref[0]
+    vt = vt_ref[0]
+    sim = jnp.sum(kt * q[:, :, None], axis=1) * scale
+    m = jnp.max(sim, axis=-1, keepdims=True)
+    p = jnp.exp(sim - m)
+    attn = p / jnp.sum(p, axis=-1, keepdims=True)
+    o_ref[0] = jnp.sum(vt * attn[:, None, :], axis=-1).astype(o_ref.dtype)
+
+
+def _block_row_bytes_jt(J: int, D: int) -> int:
+    """Per-node-row VMEM bytes for the J-on-lanes forward layout:
+    kv blocks [n_b, D, J] pad (D->8-mult sublanes, J->128 lanes);
+    q/out [n_b, D] pad D->128 lanes; sim-class [n_b, J] pads J->128."""
+    Dp8, Jl, Dl = _round_up(D, 8), _round_up(J, 128), _round_up(D, 128)
+    blocks = 2 * Dp8 * Jl + 2 * Dl + Jl
+    temps = 4 * Jl
+    return (2 * blocks + temps) * 4
+
+
+@functools.partial(jax.jit, static_argnames=('heads', 'scale', 'interpret'))
+def fused_attention_jt(q, k, v, mask, heads: int, scale: float,
+                       interpret: bool = False):
+    """J-on-lanes forward (experimental; see layout note above).
+    Same contract as fused_attention, FORWARD ONLY (no vjp, no SPMD
+    rules) — this is the measurement arm of the layout decision."""
+    BH, n, D = q.shape
+    BKV, _, J, _ = k.shape
+    group = BH // BKV
+
+    kt = k.transpose(0, 1, 3, 2)                     # [BKV, n, D, J]
+    vt = v.transpose(0, 1, 3, 2)
+
+    row = _block_row_bytes_jt(J, D)
+    block_n = 8
+    for bn in (512, 256, 128, 64, 32, 16, 8):
+        if bn * row <= _VMEM_LIMIT:
+            block_n = min(bn, max(8, _round_up(n, 8)))
+            break
+    np_ = _round_up(n, block_n)
+    if np_ != n:
+        q = jnp.pad(q, ((0, 0), (0, np_ - n), (0, 0)))
+        kt = jnp.pad(kt, ((0, 0), (0, np_ - n), (0, 0), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, np_ - n), (0, 0), (0, 0)))
+        if mask is not None:
+            mask = jnp.pad(mask, ((0, 0), (0, np_ - n), (0, 0)),
+                           constant_values=True)
+
+    in_specs = [
+        pl.BlockSpec((1, block_n, D), lambda bh, e: (bh, e, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_n, D, J),
+                     lambda bh, e: (bh // group, e, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_n, D, J),
+                     lambda bh, e: (bh // group, e, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [q, kt, vt]
+    if mask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, block_n, J), lambda bh, e: (bh // heads, e, 0),
+                         memory_space=pltpu.VMEM))
+        args.append(mask)
+        kernel = functools.partial(_kernel_jt, scale=scale)
+    else:
+        kernel = functools.partial(_kernel_jt_nomask, scale=scale)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(BH, np_ // block_n),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_n, D), lambda bh, e: (bh, e, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, np_, D), jnp.float32),
+        interpret=interpret,
+    )(*args)
+    return out[:, :n]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))

@@ -60,12 +60,48 @@ def _block_overrides(*names):
     import os
     vals = [os.environ.get(n, '') for n in names]
     if all(vals):
-        return tuple(int(v) for v in vals)
+        try:
+            return tuple(int(v) for v in vals)
+        except ValueError:
+            import warnings
+            warnings.warn(f'block override ignored: {names} must be '
+                          f'integers (got {vals})', stacklevel=2)
+            return None
     if any(vals):
         import warnings
         warnings.warn(f'block override ignored: {names} must ALL be set '
                       f'(got {vals})', stacklevel=2)
     return None
+
+
+def _validate_override(block_e, second, second_name, full_second,
+                       vmem_estimate, vmem_budget):
+    """Check an env override against the Mosaic tile-quantum rules
+    (block_e multiple of 128; the pair's second member a multiple of 8 or
+    the full axis) and the VMEM model. Quantum violations warn AND are
+    ignored (a bad value would otherwise surface as an opaque Mosaic
+    compile error, ADVICE r3 #4); an over-budget but tile-legal override
+    warns and is HONORED — sweeps probe the budget edge on purpose."""
+    import warnings
+    if block_e <= 0 or block_e % 128 != 0:
+        warnings.warn(
+            f'block override ignored: SE3_TPU_BLOCK_E={block_e} must be a '
+            f'positive multiple of 128 (Mosaic lane tiling)', stacklevel=3)
+        return False
+    if second <= 0 or (second % 8 != 0 and second < full_second):
+        warnings.warn(
+            f'block override ignored: {second_name}={second} must be a '
+            f'positive multiple of 8 or cover the full axis '
+            f'({full_second}) — Mosaic sublane tiling', stacklevel=3)
+        return False
+    est = vmem_estimate(block_e, min(second, full_second))
+    if est > vmem_budget:
+        warnings.warn(
+            f'block override working set ~{est / 2**20:.1f} MiB exceeds '
+            f'the {vmem_budget / 2**20:.0f} MiB VMEM model (honored '
+            f'anyway — expect a Mosaic VMEM error if the model is right)',
+            stacklevel=3)
+    return True
 
 
 def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
@@ -78,10 +114,15 @@ def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
     Mosaic block-shape rule: every blocked dim must either cover the full
     array or be divisible by its tile quantum — so block_if is the full IF
     (n_if == 1) or a multiple of 8, and block_e a multiple of 128."""
+    def _vmem(be, bif):
+        return 4 * (mid * be + bif * O * mid + 2 * bif * O * be
+                    + P * bif * be + P * O * be)
+
     if not bwd:  # sweeps time the forward; the bwd working set is ~2x,
         # so overrides never bypass the bwd VMEM model
         ov = _block_overrides('SE3_TPU_BLOCK_E', 'SE3_TPU_BLOCK_IF')
-        if ov:
+        if ov and _validate_override(ov[0], ov[1], 'SE3_TPU_BLOCK_IF', IF,
+                                     _vmem, vmem_budget):
             return ov[0], min(IF, ov[1])
     e_cap = _round_up(E, 128)
     for block_e in (512, 256, 128):
@@ -322,7 +363,8 @@ def fused_pairwise_conv(h: jnp.ndarray, w3: jnp.ndarray, v2: jnp.ndarray,
 
 
 def pallas_available() -> bool:
-    return jax.default_backend() == 'tpu'
+    from ..utils.helpers import is_tpu_backend
+    return is_tpu_backend()
 
 
 # --------------------------------------------------------------------- #
@@ -384,8 +426,13 @@ def _pick_blocks_bx(E: int, C: int, O: int, P: int, Q: int, F: int,
     """(block_e, cb) for the basis-fused kernel. cb is the c-chunk: a
     multiple of 8 (so the xt row-block cb*Q and w3t row-block cb*F*O are
     tile-aligned for any odd Q/F) or the full (padded) C."""
+    def _vmem(be, cb):
+        return 4 * (mid * be + cb * F * O * mid + 2 * cb * F * O * be
+                    + P * F * Q * be + cb * Q * be + P * O * be)
+
     ov = _block_overrides('SE3_TPU_BLOCK_E', 'SE3_TPU_BLOCK_CB')
-    if ov:
+    if ov and _validate_override(ov[0], ov[1], 'SE3_TPU_BLOCK_CB',
+                                 _round_up(C, 8), _vmem, vmem_budget):
         return ov
     for block_e in (512, 256, 128):
         if block_e > _round_up(E, 128):
@@ -421,9 +468,19 @@ def _pick_blocks_bx(E: int, C: int, O: int, P: int, Q: int, F: int,
     return 128, 8
 
 
-def _fused_pairwise_conv_bx_impl(h, w3, basis, x, interpret, precision):
+def _fused_pairwise_conv_bx_impl(h, w3, basis, x, interpret, precision,
+                                 pqf=None):
+    """basis is [E, P, Q, F] (structured), or — when `pqf`=(P, Q, F) is
+    given — [E, P*F*Q] pre-flattened in (p, f, q) order (the layout
+    get_basis(layout='pfq_flat') produces): the kernel operand
+    bt [P*F*Q, E] is then a plain 2D transpose instead of a 6D
+    relayout reading a ~60x tile-padded HBM buffer."""
     E, mid = h.shape
-    _, P, Q, F = basis.shape
+    if pqf is None:
+        _, P, Q, F = basis.shape
+    else:
+        P, Q, F = pqf
+        assert basis.shape == (E, P * F * Q), (basis.shape, pqf)
     C = x.shape[1]
     O = w3.shape[-1]
     assert w3.shape[1] == C * F, (w3.shape, C, F)
@@ -439,7 +496,8 @@ def _fused_pairwise_conv_bx_impl(h, w3, basis, x, interpret, precision):
     Ep = _round_up(E, block_e)
 
     ht = h.T                                          # [mid, E]
-    bt = basis.transpose(1, 3, 2, 0).reshape(P * F * Q, E)
+    bt = basis.T if pqf is not None \
+        else basis.transpose(1, 3, 2, 0).reshape(P * F * Q, E)
     xt = x.transpose(1, 2, 0).reshape(C * Q, E)
     w3t = w3.reshape(mid, C * F * O).T                # [(c,f,o), mid]
     if Cp != C:
@@ -502,6 +560,34 @@ def fused_pairwise_conv_bx(h: jnp.ndarray, w3: jnp.ndarray,
     edge/output-channel axes (see the SPMD rules above).
     """
     return _bx_partitioned(interpret, precision)(h, w3, basis, x)
+
+
+@functools.lru_cache(maxsize=None)
+def _bxf_partitioned(pqf, interpret, precision):
+    return _make_partitioned(
+        lambda h, w3, basis, x: _fused_pairwise_conv_bx_impl(
+            h, w3, basis, x, interpret, precision, pqf=pqf),
+        rule='e m, m i o, e z, e c q -> e p o',
+        need_repl=('m', 'i', 'z', 'c', 'q'),
+        arg_specs=lambda P_, e, o: (P_(e, None), P_(None, None, o),
+                                    P_(e, None), P_(e, None, None)),
+        result_specs=lambda P_, e, o: (P_(e, None, o),))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('pqf', 'interpret', 'precision'))
+def fused_pairwise_conv_bxf(h: jnp.ndarray, w3: jnp.ndarray,
+                            basis_flat: jnp.ndarray, x: jnp.ndarray,
+                            pqf: tuple, interpret: bool = False,
+                            precision=None) -> jnp.ndarray:
+    """fused_pairwise_conv_bx with the basis pre-flattened per edge to
+    [E, P*F*Q] in (p, f, q) order (get_basis layout='pfq_flat'). Same
+    math, but the HBM basis buffer is ~60x smaller at num_degrees=4: the
+    structured [.., P, Q, F] form tile-pads its two small odd minor axes
+    to (8, 128), the flat form pads one axis to the next 128 multiple.
+    pqf = (P, Q, F) static ints."""
+    return _bxf_partitioned(tuple(pqf), interpret, precision)(
+        h, w3, basis_flat, x)
 
 
 # --------------------------------------------------------------------- #

@@ -360,10 +360,19 @@ class SE3TransformerModule(nn.Module):
         # rotary embeddings (reference :1298-1325)
         pos_emb = self._rotary_embeddings(b, n, hood)
 
-        # basis, in-trace (reference :1329)
+        # basis, in-trace (reference :1329). The fused bx kernel path
+        # takes the flat (p,f,q) layout: one padded minor axis (~1.1x)
+        # instead of the structured form's (Q,F)->(8,128) tile pad (up
+        # to ~60x HBM inflation at num_degrees=4); the convs unflatten
+        # automatically if dispatch resolves away from the kernel.
+        from ..ops.conv import _use_pallas
+        layout = 'pfq_flat' if (
+            self.fuse_basis
+            and _use_pallas(self.pallas, self.pallas_interpret)) else 'pqf'
         with named_scope('basis'):
             basis = get_basis(hood.rel_pos, num_degrees - 1,
-                              differentiable=self.differentiable_coors)
+                              differentiable=self.differentiable_coors,
+                              layout=layout)
 
         edge_info = (hood.indices, hood.mask, edges)
         x = feats

@@ -16,4 +16,23 @@ os.environ['JAX_PLATFORMS'] = 'cpu'
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
-jax.config.update('jax_enable_x64', True)
+# x32 by DEFAULT: the suite must test the precision that ships on TPU
+# (f32 accumulations; reference tolerance 1e-4). With x64 globally on,
+# intermediates could silently promote and soften the equivariance
+# claim (VERDICT r3 weak #7). Files whose math genuinely needs traced
+# float64 (the Q_J/basis identities at 1e-10) opt back in via the
+# enable_x64 fixture below.
+jax.config.update('jax_enable_x64', False)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def enable_x64():
+    """Traced-float64 opt-in for cold-path math tests. Function-scoped:
+    a module-scoped fixture would stay active until module teardown and
+    leak x64 into later non-fixture tests in the same file — the silent
+    promotion this conftest exists to prevent."""
+    jax.config.update('jax_enable_x64', True)
+    yield
+    jax.config.update('jax_enable_x64', False)

@@ -39,8 +39,9 @@ def test_intertwiner_identity(d_in, d_out):
         assert np.abs(RT @ Q - Q @ DJ).max() < 1e-10
 
 
-def test_basis_equivariance():
-    """K(R r) == D_out K(r) D_in^T for every degree pair."""
+def test_basis_equivariance(enable_x64):
+    """K(R r) == D_out K(r) D_in^T for every degree pair (traced float64:
+    this is a 1e-10 math identity, not a ships-in-f32 model check)."""
     rng = np.random.RandomState(1)
     r = rng.normal(size=(6, 3))
     R = rot(0.3, 1.1, -0.7)
@@ -79,7 +80,7 @@ def test_differentiability_flag():
     assert jnp.isfinite(g0).all()
 
 
-def test_basis_jits():
+def test_basis_jits(enable_x64):
     rel_pos = jnp.asarray(np.random.RandomState(0).normal(size=(2, 4, 3, 3)))
     fn = jax.jit(lambda r: get_basis(r, 2))
     out = fn(rel_pos)

@@ -1,0 +1,157 @@
+"""Audit the flagship step's TRUE FLOP count (VERDICT r3 weak #1 and #6).
+
+The official records' step_tflops/mfu_bf16_peak came from XLA
+cost_analysis of the TPU program — which cannot see inside Pallas
+custom kernels, where the radial matmuls (the dominant FLOPs) run, AND
+counts a lax.map (edge_chunks) body once instead of trip-count times.
+This script compiles the SAME training step with pallas=False on CPU
+and prints its cost analysis, plus an analytic per-component model
+(se3_transformer_tpu.utils.flops) for cross-checking. Run with
+--edge-chunks 0 for the clean audit (no lax.map: every FLOP visible).
+
+Measured (dim=64 flagship, n=1024, k=32): analytic 83.2 TFLOP/step;
+XLA-visible with edge_chunks=8: 12.16 (map bodies once); TPU Pallas
+path records: 2.05 (kernels invisible too). bench.py now records the
+analytic number alongside the XLA-visible one.
+
+Usage: python scripts/flop_audit.py [--dim 64] [--nodes 1024] [--k 32]
+       [--compile] [--edge-chunks 0]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def analytic_model(dim, depth, num_degrees, n, k, heads, dim_head,
+                   mid=129):
+    """Forward-pass FLOPs (multiply+add = 2) of the flagship's dominant
+    terms. Per edge-conv over fibers (c per degree), the radial weight
+    application h[mid] @ w3[mid, c_in*F, c_out] dominates:
+    2*mid*sum_pairs(c_in*F*c_out) per edge."""
+    E = n * k
+    c = dim
+    sumF = sum(2 * min(di, do) + 1
+               for di in range(num_degrees) for do in range(num_degrees))
+    # radial apply per full hidden->hidden conv (all pairs)
+    radial_per_conv = 2 * E * mid * sumF * c * c
+    # v2 basis contraction: sum_pairs P*Q*F*c per edge (tiny next to radial)
+    sumPQF = sum((2 * do + 1) * (2 * di + 1) * (2 * min(di, do) + 1)
+                 for di in range(num_degrees) for do in range(num_degrees))
+    v2_per_conv = 2 * E * sumPQF * c
+    # kernel-feature contraction out[e,P,o] = v2[e,P,IF] R[e,IF,o]
+    sumPIFO = sum((2 * do + 1) * c * (2 * min(di, do) + 1) * c
+                  for di in range(num_degrees) for do in range(num_degrees))
+    contract_per_conv = 2 * E * sumPIFO
+    # radial trunk (shared): 2 layers mid x mid per edge per conv
+    trunk_per_conv = 2 * E * (2 * mid * mid)
+
+    conv = radial_per_conv + v2_per_conv + contract_per_conv + trunk_per_conv
+    # per attention block: k-conv + v-conv (hidden->kv, kv dim =
+    # heads*dim_head per degree ~= c) + attention einsums (small)
+    att_sim = 2 * E * heads * sum(dim_head * (2 * d + 1)
+                                  for d in range(num_degrees)) * 2
+    block = 2 * conv + att_sim
+    # conv_in: input degree 0 only -> hidden (pairs (0, do))
+    sumF_in = num_degrees  # F=1 for every (0, do)
+    conv_in = 2 * E * mid * sumF_in * c * c
+    fwd = depth * block + conv_in + conv  # + conv_out ~ one more conv
+    return dict(conv_tflop=conv / 1e12, fwd_tflop=fwd / 1e12,
+                # reversible remat: step ~= fwd + (re-fwd + bwd 2x) = 4x
+                step_tflop_4x=4 * fwd / 1e12)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--dim', type=int, default=64)
+    ap.add_argument('--nodes', type=int, default=1024)
+    ap.add_argument('--k', type=int, default=32)
+    ap.add_argument('--compile', action='store_true',
+                    help='also compile the pallas=False step on CPU and '
+                         'print XLA cost analysis (slow: ~10-15 min)')
+    ap.add_argument('--edge-chunks', type=int, default=None,
+                    help='0 = unchunked (no lax.map undercount); default '
+                         'keeps the recipe default (8)')
+    args = ap.parse_args(argv)
+
+    print(json.dumps(dict(analytic=analytic_model(
+        args.dim, 6, 4, args.nodes, args.k, 8, max(8, args.dim // 8)))),
+        flush=True)
+    try:
+        import jax as _jax
+        _jax.config.update('jax_platforms', 'cpu')
+        from se3_transformer_tpu.training import recipes as _recipes
+        from se3_transformer_tpu.utils.flops import (
+            train_step_flops_estimate,
+        )
+        _m = _recipes.RECIPES['flagship'](
+            dim=args.dim, num_neighbors=args.k, output_degrees=2,
+            reduce_dim_out=True)
+        print(json.dumps(dict(package_estimate_tflop=round(
+            train_step_flops_estimate(_m, args.nodes, args.k) / 1e12, 2))),
+            flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(f'package estimate failed: {e}', file=sys.stderr)
+
+    if not args.compile:
+        return
+
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from se3_transformer_tpu.training import recipes
+
+    kwargs = {}
+    if args.edge_chunks is not None:
+        # 0 means "no chunking at all" (recipe default is 8)
+        kwargs['edge_chunks'] = args.edge_chunks or None
+    module = recipes.RECIPES['flagship'](
+        dim=args.dim, num_neighbors=args.k, output_degrees=2,
+        reduce_dim_out=True, pallas=False, **kwargs)
+    rng = np.random.RandomState(0)
+    feats = jnp.asarray(rng.normal(size=(1, args.nodes, args.dim)),
+                        jnp.float32)
+    coords = jnp.asarray(np.cumsum(
+        rng.normal(size=(1, args.nodes, 3)), axis=1), jnp.float32)
+    masks = jnp.ones((1, args.nodes), bool)
+
+    def loss_fn(params, coords, key):
+        noise = jax.random.normal(key, coords.shape, coords.dtype)
+        noised = coords + noise
+        out = module.apply({'params': params}, feats, noised, mask=masks,
+                           return_type=1)
+        return (((noised + out) - coords) ** 2).sum(-1).mean()
+
+    shapes = jax.eval_shape(
+        lambda key: module.init(key, feats, coords, mask=masks,
+                                return_type=1), jax.random.PRNGKey(0))
+    params = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)['params']
+    opt = optax.adam(1e-4)
+    opt_state = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(opt.init, params))
+
+    @jax.jit
+    def step(params, opt_state, coords, key):
+        loss, grads = jax.value_and_grad(loss_fn)(params, coords, key)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    lowered = step.lower(params, opt_state, coords, jax.random.PRNGKey(1))
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    flops = float(cost.get('flops', 0.0))
+    print(json.dumps(dict(xla_path_step_tflop=round(flops / 1e12, 3),
+                          note='pallas=False: every FLOP visible to XLA '
+                               'cost analysis')), flush=True)
+
+
+if __name__ == '__main__':
+    main()

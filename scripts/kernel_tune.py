@@ -28,15 +28,18 @@ import jax, numpy as np, jax.numpy as jnp
 from se3_transformer_tpu.utils.compilation_cache import enable_compilation_cache
 enable_compilation_cache()
 from se3_transformer_tpu.kernels.pallas_pairwise import (
-    fused_pairwise_conv, fused_pairwise_conv_bx, _pick_blocks,
-    _pick_blocks_bx,
+    fused_pairwise_conv, fused_pairwise_conv_bx, fused_pairwise_conv_bxf,
+    _pick_blocks, _pick_blocks_bx,
 )
 kind = os.environ['SE3_TUNE_KIND']
 iters = int(os.environ['SE3_TUNE_ITERS'])
 rng = np.random.RandomState(0)
 # flagship-relevant shape class: E = 1024*32 edges, shared-radial group
 # contraction for the widest output degree (dim=64, deg=4 -> IF=1024,
-# O=64, P=7, mid=65 incl. bias row); bx: C=64, Q, F up to 7
+# O=64, P=7, mid=65 incl. bias row); bx: C=64, Q, F up to 7.
+# 'bxf' = same contraction fed the flat (p,f,q) basis layout: isolates
+# the HBM-operand effect (structured [E,P,Q,F] tile-pads (Q,F)->(8,128),
+# ~21x for this shape; flat [E, P*F*Q] pads 343->384).
 if kind == 'plain':
     E, mid, IF, O, P = 32768, 65, 1024, 64, 7
     h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
@@ -48,9 +51,13 @@ else:
     E, mid, C, Q, F, O, P = 32768, 65, 64, 7, 7, 64, 7
     h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
     w3 = jnp.asarray(rng.normal(size=(mid, C * F, O)), jnp.float32)
-    bas = jnp.asarray(rng.normal(size=(E, P, Q, F)), jnp.float32)
     x = jnp.asarray(rng.normal(size=(E, C, Q)), jnp.float32)
-    fn = lambda: fused_pairwise_conv_bx(h, w3, bas, x)
+    if kind == 'bxf':
+        flat = jnp.asarray(rng.normal(size=(E, P * F * Q)), jnp.float32)
+        fn = lambda: fused_pairwise_conv_bxf(h, w3, flat, x, (P, Q, F))
+    else:
+        bas = jnp.asarray(rng.normal(size=(E, P, Q, F)), jnp.float32)
+        fn = lambda: fused_pairwise_conv_bx(h, w3, bas, x)
     blocks = _pick_blocks_bx(E, C, O, P, Q, F, mid)
 out = jax.block_until_ready(fn())  # compile
 t0 = time.time()
@@ -106,6 +113,8 @@ def main(argv=None):
     for kind, sizes_key, sizes in (('plain', 'SE3_TPU_BLOCK_IF',
                                     args.block_if),
                                    ('bx', 'SE3_TPU_BLOCK_CB',
+                                    args.block_cb),
+                                   ('bxf', 'SE3_TPU_BLOCK_CB',
                                     args.block_cb)):
         run(kind, {})  # heuristic default first: the baseline to beat
         for be in args.block_e:

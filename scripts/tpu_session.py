@@ -29,20 +29,29 @@ def log(msg):
         f.write(line + '\n')
 
 
-def _best_probe_batch(probe_path, since_offset=0):
-    """Highest-throughput fitting fast batch>1 probe point (dim=64), or
-    None. Drives the batched flagship record: the probe measures which
-    batch still fits HBM and what it yields; the bench then records the
-    best one at full step count. PROBE_TPU.jsonl is append-only across
-    sessions — since_offset (byte position captured before this
-    session's probe ran) restricts the scan to records the CURRENT
-    build actually produced, and the fast filter keeps conservative
-    points from electing a batch the fast program never proved fits."""
+def _best_probe_batch(probe_path):
+    """Highest-throughput fitting fast batch>1 probe point (dim=64,
+    n=1024, on-chip, measured under the CURRENT package code), or None.
+    Drives the batched flagship record: the probe measures which batch
+    still fits HBM and what it yields; the bench then records the best
+    one at full step count. The whole append-only file is scanned — the
+    probe skips already-measured points (--skip-done), so after a
+    tunnel death the winning batch record may predate this session's
+    probe run; the code_rev filter (the package-tree fingerprint
+    tpu_probe stamps into every record) keeps stale-build numbers out
+    of the election, and MIN_REAL_STEP_MS guards against dying-tunnel
+    artifact records (a 31 ms flagship "timing" was appended seconds
+    before the 13:29Z death)."""
     import json
+    import tpu_probe
+    fingerprint = tpu_probe.package_fingerprint()
+    if fingerprint is None:
+        # without a build identity the election cannot distinguish
+        # stale-build records; refuse rather than elect a wrong batch
+        return None
     best, best_tput = None, 0.0
     try:
         with open(probe_path) as f:
-            f.seek(since_offset)
             for line in f:
                 try:
                     rec = json.loads(line)
@@ -50,7 +59,11 @@ def _best_probe_batch(probe_path, since_offset=0):
                     continue
                 b = rec.get('batch', 1)
                 if (rec.get('fits') and rec.get('fast') and b and b > 1
-                        and rec.get('dim') == 64
+                        and rec.get('dim') == 64 and rec.get('n') == 1024
+                        and rec.get('backend') not in (None, 'cpu')
+                        and rec.get('code_rev') == fingerprint
+                        and rec.get('step_ms', 0)
+                        > tpu_probe.min_real_step_ms(1024)
                         and rec.get('nodes_steps_per_sec', 0) > best_tput):
                     best, best_tput = b, rec['nodes_steps_per_sec']
     except OSError:
@@ -216,20 +229,19 @@ def main():
         log(f'run_baselines: completed ({out_path})')
 
     probe_path = os.path.join(os.path.dirname(here), 'PROBE_TPU.jsonl')
-    probe_offset = [0]
 
     def stage_probe():
-        try:
-            probe_offset[0] = os.path.getsize(probe_path)
-        except OSError:
-            probe_offset[0] = 0
         import tpu_probe
-        tpu_probe.main(['--steps', '3', '--fast',
+        # --skip-done: the loop re-runs this stage after every tunnel
+        # death; already-measured points must not burn another cycle.
+        # The non-reversible arm stays off (--nonrev) — its compile
+        # killed the tunnel at 12:51Z and 13:29Z
+        tpu_probe.main(['--steps', '3', '--fast', '--skip-done',
                         '--batches', '2', '4', '8'])
         log('tpu_probe: completed (PROBE_TPU.jsonl)')
 
     def stage_batched_record():
-        best = _best_probe_batch(probe_path, probe_offset[0])
+        best = _best_probe_batch(probe_path)
         if best is None:
             log('no fitting batch>1 probe point; skipping batched record')
         else:

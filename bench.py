@@ -151,13 +151,23 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
         batch = int(os.environ.get('SE3_TPU_BENCH_BATCH', batch))
         dim = 64
         recipe_name = 'flagship_fast' if fast else 'flagship'
+        # SE3_TPU_BENCH_CHUNKS overrides the recipe's edge_chunks (0 =
+        # unchunked). Used by the session's batched record so the bench
+        # runs the SAME chunk setting the probe measured as fitting for
+        # the elected batch (a b>1 that fits chunked can OOM unchunked);
+        # the label carries ec= whenever the override is set, so an
+        # overridden record is always distinguishable from a bare run.
+        chunk_env = os.environ.get('SE3_TPU_BENCH_CHUNKS', '')
+        overrides = dict(output_degrees=2, reduce_dim_out=True)
+        if chunk_env != '':
+            overrides['edge_chunks'] = int(chunk_env) or None
         # vector head for the denoise objective: the recipe default
         # output_degrees=1 is scalar-out (return_type coerced to 0)
-        module = recipes.RECIPES[recipe_name](
-            dim=dim, output_degrees=2, reduce_dim_out=True)
+        module = recipes.RECIPES[recipe_name](dim=dim, **overrides)
         num_degrees = module.num_degrees
         label = f'{recipe_name},dim={dim},depth={module.depth}' + (
-            f',b={batch}' if batch != 1 else '')
+            f',b={batch}' if batch != 1 else '') + (
+            f',ec={int(chunk_env)}' if chunk_env != '' else '')
     else:
         # liveness fallback only (wedged/absent TPU): tiny config so the
         # bench still completes and is honestly labelled backend=cpu.

@@ -198,10 +198,21 @@ def main(argv=None):
             # per-chip throughput scales with batch while HBM lasts (the
             # reference's own training runs 16 accumulated micro-batches,
             # denoise.py:13,55) — measure the batch ceiling at the
-            # primary width using the most memory-lean chunk setting
+            # primary width. Primary chunk setting matches what the
+            # batched BENCH record will run (the fast recipe is
+            # unchunked since the round-4 re-cut); a batch that OOMs
+            # unchunked falls back to the most memory-lean chunked
+            # setting and the sweep continues there, so the election
+            # (tpu_session._best_probe_batch) can pick a (batch,
+            # edge_chunks) pair the bench is guaranteed to fit.
+            bchunks = 0 if args.fast else max(args.chunks)
             for b in sorted(args.batches):
-                rec = run_and_record(dim=dim, edge_chunks=max(args.chunks),
+                rec = run_and_record(dim=dim, edge_chunks=bchunks,
                                      batch=b, fast=args.fast)
+                if not rec['fits'] and bchunks != max(args.chunks):
+                    bchunks = max(args.chunks)
+                    rec = run_and_record(dim=dim, edge_chunks=bchunks,
+                                         batch=b, fast=args.fast)
                 if not rec['fits']:
                     break
         if not dim_fits:

@@ -65,7 +65,12 @@ def _best_probe_batch(probe_path):
                         and rec.get('step_ms', 0)
                         > tpu_probe.min_real_step_ms(1024)
                         and rec.get('nodes_steps_per_sec', 0) > best_tput):
-                    best, best_tput = b, rec['nodes_steps_per_sec']
+                    # carry the measured chunk setting with the batch:
+                    # the bench must run the exact program the probe
+                    # proved to fit (a b>1 fitting chunked can OOM
+                    # unchunked)
+                    best = (b, rec.get('edge_chunks', 0))
+                    best_tput = rec['nodes_steps_per_sec']
     except OSError:
         return None
     return best
@@ -203,11 +208,16 @@ def main():
         else:
             log('kernel_smoke: all pass')
 
-    def make_bench_stage(fast, batch=None):
+    def make_bench_stage(fast, batch=None, edge_chunks=None):
         def stage():
             import bench
             if batch is not None:
                 os.environ['SE3_TPU_BENCH_BATCH'] = str(batch)
+                # the probe-elected chunk setting travels with the
+                # batch: the bench must run the program the probe
+                # proved fits (0 = unchunked)
+                if edge_chunks is not None:
+                    os.environ['SE3_TPU_BENCH_CHUNKS'] = str(edge_chunks)
                 # the twin equivariance number is already in this
                 # session's fast record — don't re-compile it over the
                 # tunnel
@@ -219,6 +229,7 @@ def main():
             finally:
                 if batch is not None:
                     os.environ.pop('SE3_TPU_BENCH_BATCH', None)
+                    os.environ.pop('SE3_TPU_BENCH_CHUNKS', None)
                     os.environ.pop('SE3_TPU_BENCH_EQ', None)
         return stage
 
@@ -245,7 +256,8 @@ def main():
         if best is None:
             log('no fitting batch>1 probe point; skipping batched record')
         else:
-            make_bench_stage(fast=True, batch=best)()
+            b, ec = best
+            make_bench_stage(fast=True, batch=b, edge_chunks=ec)()
 
     def stage_kernel_tune():
         import kernel_tune

@@ -26,7 +26,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def analytic_model(dim, depth, num_degrees, n, k, heads, dim_head,
-                   mid=129):
+                   mid=128):  # trunk width; bias un-folded in round 4
     """Forward-pass FLOPs (multiply+add = 2) of the flagship's dominant
     terms. Per edge-conv over fibers (c per degree), the radial weight
     application h[mid] @ w3[mid, c_in*F, c_out] dominates:

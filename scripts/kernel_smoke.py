@@ -34,44 +34,55 @@ def main():
     from se3_transformer_tpu.kernels.pallas_pairwise import (
         fused_pairwise_conv, fused_pairwise_conv_bwd,
     )
-    for (E, mid, IF, O, P) in [(300, 129, 24, 8, 5), (64, 33, 280, 20, 7),
-                               (1000, 129, 56, 8, 7)]:
+    # mid=128 is the production value since the bias un-folding (the
+    # bias is a [S, 1] operand now, not a 129th contraction row); the
+    # smoke MUST cover the in-kernel lane-broadcast add and the db3
+    # lane-reduce on real Mosaic
+    # (64, 100, ...) keeps one deliberately sublane-UNALIGNED mid in the
+    # on-chip gate: mid_dim is user-settable and the mid % 8 != 0 padding
+    # path must stay covered on real Mosaic
+    for (E, mid, IF, O, P) in [(300, 128, 24, 8, 5), (64, 100, 280, 20, 7),
+                               (1000, 128, 56, 8, 7)]:
         h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
         w3 = jnp.asarray(rng.normal(size=(mid, IF, O)), jnp.float32)
+        b3 = jnp.asarray(rng.normal(size=(IF, O)), jnp.float32)
         v2 = jnp.asarray(rng.normal(size=(E, P, IF)), jnp.float32)
         g = jnp.asarray(rng.normal(size=(E, P, O)), jnp.float32)
 
         with jax.default_matmul_precision('highest'):
             ref = jnp.einsum('epk,eko->epo', v2,
-                             jnp.einsum('em,mko->eko', h, w3))
-        out = fused_pairwise_conv(h, w3, v2, precision='highest')
+                             jnp.einsum('em,mko->eko', h, w3) + b3)
+        out = fused_pairwise_conv(h, w3, v2, b3=b3, precision='highest')
         ok &= check(f'pairwise fwd E={E} IF={IF} O={O} P={P}', out, ref)
 
-        def f(h, w3, v2):
-            r = jnp.einsum('em,mko->eko', h, w3)
+        def f(h, w3, b3, v2):
+            r = jnp.einsum('em,mko->eko', h, w3) + b3
             return (jnp.einsum('epk,eko->epo', v2, r) * g).sum()
 
         with jax.default_matmul_precision('highest'):
-            dh_r, dw3_r, dv2_r = jax.grad(f, argnums=(0, 1, 2))(h, w3, v2)
-        dh, dw3, dv2 = fused_pairwise_conv_bwd(h, w3, v2, g,
-                                               precision='highest')
+            dh_r, dw3_r, db3_r, dv2_r = jax.grad(
+                f, argnums=(0, 1, 2, 3))(h, w3, b3, v2)
+        dh, dw3, dv2, db3 = fused_pairwise_conv_bwd(h, w3, v2, g, b3=b3,
+                                                    precision='highest')
         ok &= check(f'pairwise bwd dh  E={E}', dh, dh_r)
         ok &= check(f'pairwise bwd dw3 E={E}', dw3, dw3_r)
         ok &= check(f'pairwise bwd dv2 E={E}', dv2, dv2_r)
+        ok &= check(f'pairwise bwd db3 E={E}', db3, db3_r)
 
     # --- radial_bf16 operands under an fp32 context precision: Mosaic
     # rejects contract_precision<fp32> on bf16 lhs ("Bad lhs type"); the
     # kernel must force DEFAULT (bf16 multiply, f32 accumulate) ---
-    E, mid, IF, O, P = 300, 129, 24, 8, 5
+    E, mid, IF, O, P = 300, 128, 24, 8, 5
     h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
     w3 = jnp.asarray(rng.normal(size=(mid, IF, O)), jnp.float32)
+    b3 = jnp.asarray(rng.normal(size=(IF, O)), jnp.float32)
     v2 = jnp.asarray(rng.normal(size=(E, P, IF)), jnp.float32)
     with jax.default_matmul_precision('highest'):
         ref = jnp.einsum('epk,eko->epo', v2,
-                         jnp.einsum('em,mko->eko', h, w3))
+                         jnp.einsum('em,mko->eko', h, w3) + b3)
     with jax.default_matmul_precision('float32'):
         out = fused_pairwise_conv(h.astype(jnp.bfloat16),
-                                  w3.astype(jnp.bfloat16), v2,
+                                  w3.astype(jnp.bfloat16), v2, b3=b3,
                                   precision='float32')
     ok &= check('pairwise fwd bf16-radial @ f32 ctx', out, ref, tol=3e-2)
 
@@ -80,18 +91,20 @@ def main():
     from se3_transformer_tpu.kernels.pallas_pairwise import (
         fused_pairwise_conv_bx,
     )
-    for (E, mid, C, Q, F, O, P) in [(300, 129, 8, 3, 3, 8, 5),
-                                    (64, 129, 9, 5, 3, 4, 5),
-                                    (1000, 129, 8, 7, 7, 8, 7)]:
+    for (E, mid, C, Q, F, O, P) in [(300, 128, 8, 3, 3, 8, 5),
+                                    (64, 128, 9, 5, 3, 4, 5),
+                                    (1000, 128, 8, 7, 7, 8, 7)]:
         h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
         w3 = jnp.asarray(rng.normal(size=(mid, C * F, O)), jnp.float32)
+        b3 = jnp.asarray(rng.normal(size=(C * F, O)), jnp.float32)
         bas = jnp.asarray(rng.normal(size=(E, P, Q, F)), jnp.float32)
         x = jnp.asarray(rng.normal(size=(E, C, Q)), jnp.float32)
         with jax.default_matmul_precision('highest'):
             v2 = jnp.einsum('epqf,ecq->epcf', bas, x).reshape(E, P, C * F)
             ref = jnp.einsum('epk,eko->epo', v2,
-                             jnp.einsum('em,mko->eko', h, w3))
-        out = fused_pairwise_conv_bx(h, w3, bas, x, precision='highest')
+                             jnp.einsum('em,mko->eko', h, w3) + b3)
+        out = fused_pairwise_conv_bx(h, w3, bas, x, b3=b3,
+                                     precision='highest')
         ok &= check(f'pairwise bx fwd E={E} C={C} Q={Q} F={F}', out, ref)
 
         # flat-basis twin (bxf): the layout the flagship fast path now
@@ -101,7 +114,7 @@ def main():
             fused_pairwise_conv_bxf,
         )
         flat = jnp.swapaxes(bas, -1, -2).reshape(E, P * F * Q)
-        outf = fused_pairwise_conv_bxf(h, w3, flat, x, (P, Q, F),
+        outf = fused_pairwise_conv_bxf(h, w3, flat, x, (P, Q, F), b3=b3,
                                        precision='highest')
         ok &= check(f'pairwise bxf fwd E={E} C={C} Q={Q} F={F}', outf, ref)
 

@@ -35,29 +35,34 @@ kind = os.environ['SE3_TUNE_KIND']
 iters = int(os.environ['SE3_TUNE_ITERS'])
 rng = np.random.RandomState(0)
 # flagship-relevant shape class: E = 1024*32 edges, shared-radial group
-# contraction for the widest output degree (dim=64, deg=4 -> IF=1024,
-# O=64, P=7, mid=65 incl. bias row); bx: C=64, Q, F up to 7.
+# contraction for the widest output degree (dim=64, deg=4 -> IF up to
+# 1024, O=64, P=7, mid=128 — the radial trunk width, DEFAULT_MID_DIM;
+# the bias is a separate [S, 1] operand since the round-4 un-folding);
+# bx: C=64, Q, F up to 7.
 # 'bxf' = same contraction fed the flat (p,f,q) basis layout: isolates
 # the HBM-operand effect (structured [E,P,Q,F] tile-pads (Q,F)->(8,128),
 # ~21x for this shape; flat [E, P*F*Q] pads 343->384).
 if kind == 'plain':
-    E, mid, IF, O, P = 32768, 65, 1024, 64, 7
+    E, mid, IF, O, P = 32768, 128, 1024, 64, 7
     h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
     w3 = jnp.asarray(rng.normal(size=(mid, IF, O)), jnp.float32)
+    b3 = jnp.asarray(rng.normal(size=(IF, O)), jnp.float32)
     v2 = jnp.asarray(rng.normal(size=(E, P, IF)), jnp.float32)
-    fn = lambda: fused_pairwise_conv(h, w3, v2)
+    fn = lambda: fused_pairwise_conv(h, w3, v2, b3=b3)
     blocks = _pick_blocks(E, IF, O, P, mid)
 else:
-    E, mid, C, Q, F, O, P = 32768, 65, 64, 7, 7, 64, 7
+    E, mid, C, Q, F, O, P = 32768, 128, 64, 7, 7, 64, 7
     h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
     w3 = jnp.asarray(rng.normal(size=(mid, C * F, O)), jnp.float32)
+    b3 = jnp.asarray(rng.normal(size=(C * F, O)), jnp.float32)
     x = jnp.asarray(rng.normal(size=(E, C, Q)), jnp.float32)
     if kind == 'bxf':
         flat = jnp.asarray(rng.normal(size=(E, P * F * Q)), jnp.float32)
-        fn = lambda: fused_pairwise_conv_bxf(h, w3, flat, x, (P, Q, F))
+        fn = lambda: fused_pairwise_conv_bxf(h, w3, flat, x, (P, Q, F),
+                                             b3=b3)
     else:
         bas = jnp.asarray(rng.normal(size=(E, P, Q, F)), jnp.float32)
-        fn = lambda: fused_pairwise_conv_bx(h, w3, bas, x)
+        fn = lambda: fused_pairwise_conv_bx(h, w3, bas, x, b3=b3)
     blocks = _pick_blocks_bx(E, C, O, P, Q, F, mid)
 out = jax.block_until_ready(fn())  # compile
 t0 = time.time()

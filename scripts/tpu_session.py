@@ -30,8 +30,9 @@ def log(msg):
 
 
 def _best_probe_batch(probe_path):
-    """Highest-throughput fitting fast batch>1 probe point (dim=64,
-    n=1024, on-chip, measured under the CURRENT package code), or None.
+    """(batch, edge_chunks) of the highest-throughput fitting fast
+    batch>1 probe point (dim=64, n=1024, on-chip, measured under the
+    CURRENT package code), or None.
     Drives the batched flagship record: the probe measures which batch
     still fits HBM and what it yields; the bench then records the best
     one at full step count. The whole append-only file is scanned — the

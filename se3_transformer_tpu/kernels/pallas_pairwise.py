@@ -17,13 +17,22 @@ or a sublane reduction. All reshapes/transposes happen OUTSIDE the kernel
 in XLA, where they are free relayouts. The layout that makes that
 possible puts the EDGE axis on lanes:
 
-    hT  [mid, E]        radial-MLP hidden, transposed (bias folded: ones row)
+    hT  [mid, E]        radial-MLP hidden, transposed
     w3T [IF*O, mid]     final radial weight, (if, o) flattened if-major
+    b3T [IF*O, 1]       radial bias column, same row order as w3T
     v2T [P, IF, E]      = sum_Q B[e,P,Q,f] x[e,i,Q], edge-last
     per (e-block, if-chunk) program:
-        rT   = w3T_chunk @ hT_blk            # one 2D MXU matmul, R in VMEM
+        rT   = w3T_chunk @ hT_blk + b3T_chunk   # one 2D MXU matmul + a
+                                                # [S,1]-over-lanes broadcast
         out[pO+o, e] += v2T[p, i, e] * rT[iO+o, e]   # P*bif sublane FMAs
     outT [P*O, E] -> transpose/reshape outside -> out [E, P, O]
+
+    The bias rides as its own [S, 1] operand rather than folded into the
+    matmul (a ones column on h / bias row on w3, the pre-round-4 design):
+    folding made the contraction dim mid+1 = 129, and the MXU contracts
+    in 128-chunks — the dominant dot (~95% of ALL flagship FLOPs, see
+    utils/flops.py) paid a second, 1/129-useful pass, a structural ~2x
+    tax on every path. mid stays exactly 128 now.
 
 The grid is (n_e, n_if) with the out block revisited across the inner
 if-axis (consecutive revisits — the legal TPU accumulation pattern), so
@@ -115,8 +124,9 @@ def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
     array or be divisible by its tile quantum — so block_if is the full IF
     (n_if == 1) or a multiple of 8, and block_e a multiple of 128."""
     def _vmem(be, bif):
-        return 4 * (mid * be + bif * O * mid + 2 * bif * O * be
-                    + P * bif * be + P * O * be)
+        # bif*O*128: the [S, 1] bias column tile-pads its lane dim to 128
+        return 4 * (mid * be + bif * O * mid + bif * O * 128
+                    + 2 * bif * O * be + P * bif * be + P * O * be)
 
     if not bwd:  # sweeps time the forward; the bwd working set is ~2x,
         # so overrides never bypass the bwd VMEM model
@@ -137,12 +147,13 @@ def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
             rt = block_if * O * block_e
             v2 = P * block_if * block_e
             out = P * O * block_e
-            total = 4 * (ht + w3 + 2 * rt + v2 + out)
+            b3 = block_if * O * 128  # [S, 1] bias column, lanes pad to 128
+            total = 4 * (ht + w3 + b3 + 2 * rt + v2 + out)
             if bwd:
                 # kernel A additionally holds h_p (block_e*mid), the gT
-                # block (= out-sized), the dv2 block (= v2-sized) and the
-                # dw3 block (= w3-sized)
-                total += 4 * (block_e * mid + out + v2 + w3)
+                # block (= out-sized), the dv2 block (= v2-sized), the
+                # dw3 block (= w3-sized) and the db3 block (= b3-sized)
+                total += 4 * (block_e * mid + out + v2 + w3 + b3)
             if total <= vmem_budget:
                 return block_e, block_if
             if block_if <= 8:
@@ -151,14 +162,17 @@ def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
     return 128, min(IF, 8)
 
 
-def _fwd_kernel(ht_ref, w3t_ref, v2t_ref, o_ref, *, P, O, bif, precision):
+def _fwd_kernel(ht_ref, w3t_ref, b3t_ref, v2t_ref, o_ref, *, P, O, bif,
+                precision):
     f = pl.program_id(1)
-    # R chunk, transposed: [bif*O, E_b] — exists only in VMEM
+    # R chunk, transposed: [bif*O, E_b] — exists only in VMEM. The bias
+    # column broadcasts over lanes ([S, 1] + [S, E], the row-stat pattern
+    # flash-attention kernels lower every day).
     rt = jax.lax.dot_general(
         w3t_ref[:], ht_ref[:],
         dimension_numbers=(((1,), (0,)), ((), ())),
         precision=precision,
-        preferred_element_type=jnp.float32)
+        preferred_element_type=jnp.float32) + b3t_ref[:]
     for p in range(P):
         acc = None
         for i in range(bif):
@@ -188,7 +202,16 @@ def _to_lanes(h, w3, v2, g=None):
     return ht, w3t, v2t, gt
 
 
-def _fused_pairwise_conv_impl(h, w3, v2, interpret, precision):
+def _bias_column(b3, IF, O, IFp):
+    """[IF, O] bias -> [IFp*O, 1] kernel operand in w3T row order
+    ((if, o) if-major), zero rows for the padded if's."""
+    b3t = b3.astype(jnp.float32).reshape(IF * O, 1)
+    if IFp != IF:
+        b3t = jnp.pad(b3t, ((0, (IFp - IF) * O), (0, 0)))
+    return b3t
+
+
+def _fused_pairwise_conv_impl(h, w3, b3, v2, interpret, precision):
     E, mid = h.shape
     _, IF, O = w3.shape
     P = v2.shape[1]
@@ -207,6 +230,7 @@ def _fused_pairwise_conv_impl(h, w3, v2, interpret, precision):
     Ep, IFp = _round_up(E, block_e), _round_up(IF, block_if)
 
     ht, w3t, v2t, _ = _to_lanes(h, w3, v2)
+    b3t = _bias_column(b3, IF, O, IFp)
     if Ep != E:
         ht = jnp.pad(ht, ((0, 0), (0, Ep - E)))
         v2t = jnp.pad(v2t, ((0, 0), (0, 0), (0, Ep - E)))
@@ -225,6 +249,8 @@ def _fused_pairwise_conv_impl(h, w3, v2, interpret, precision):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((block_if * O, mid), lambda e, f: (f, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_if * O, 1), lambda e, f: (f, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec((P, block_if, block_e), lambda e, f: (0, f, e),
                          memory_space=pltpu.VMEM),
         ],
@@ -232,7 +258,7 @@ def _fused_pairwise_conv_impl(h, w3, v2, interpret, precision):
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((P * O, Ep), jnp.float32),
         interpret=interpret,
-    )(ht, w3t, v2t)
+    )(ht, w3t, b3t, v2t)
 
     return outt.reshape(P, O, Ep).transpose(2, 0, 1)[:E]
 
@@ -339,27 +365,33 @@ def _make_partitioned(impl, rule, need_repl, arg_specs, result_specs,
 @functools.lru_cache(maxsize=None)
 def _fwd_partitioned(interpret, precision):
     return _make_partitioned(
-        lambda h, w3, v2: _fused_pairwise_conv_impl(h, w3, v2, interpret,
-                                                    precision),
-        rule='e m, m k o, e p k -> e p o', need_repl=('m', 'k'),
+        lambda h, w3, b3, v2: _fused_pairwise_conv_impl(h, w3, b3, v2,
+                                                        interpret,
+                                                        precision),
+        rule='e m, m k o, k o, e p k -> e p o', need_repl=('m', 'k'),
         arg_specs=lambda P_, e, o: (P_(e, None), P_(None, None, o),
-                                    P_(e, None, None)),
+                                    P_(None, o), P_(e, None, None)),
         result_specs=lambda P_, e, o: (P_(e, None, o),))
 
 
 @functools.partial(jax.jit, static_argnames=('interpret', 'precision'))
 def fused_pairwise_conv(h: jnp.ndarray, w3: jnp.ndarray, v2: jnp.ndarray,
+                        b3: jnp.ndarray = None,
                         interpret: bool = False,
                         precision=None) -> jnp.ndarray:
-    """h [E, mid], w3 [mid, IF, O], v2 [E, P, IF] -> out [E, P, O] (f32).
+    """h [E, mid], w3 [mid, IF, O], v2 [E, P, IF], b3 [IF, O] (optional,
+    zeros when None) -> out [E, P, O] (f32): out = v2 . (h@w3 + b3).
 
-    Fold the radial bias by appending a ones column to h and the bias row
-    to w3 before calling (see PairwiseConvSE3). `precision` feeds the
-    in-kernel MXU dots (captured from jax.default_matmul_precision by the
-    caller — the kernel body traces outside that context). Partitions
-    over sharded edge/output-channel axes (see the SPMD rules above).
+    The bias is a separate [S, 1] kernel operand, NOT folded into the
+    contraction — folding made mid 129 and cost a structural ~2x on the
+    dominant dot (module docstring). `precision` feeds the in-kernel MXU
+    dots (captured from jax.default_matmul_precision by the caller — the
+    kernel body traces outside that context). Partitions over sharded
+    edge/output-channel axes (see the SPMD rules above).
     """
-    return _fwd_partitioned(interpret, precision)(h, w3, v2)
+    if b3 is None:
+        b3 = jnp.zeros(w3.shape[1:], jnp.float32)
+    return _fwd_partitioned(interpret, precision)(h, w3, b3, v2)
 
 
 def pallas_available() -> bool:
@@ -390,14 +422,14 @@ def pallas_available() -> bool:
 # Grid (n_e, n_c) with the out block accumulated over the inner c axis.
 
 
-def _fwd_bx_kernel(ht_ref, w3t_ref, bt_ref, xt_ref, o_ref, *,
+def _fwd_bx_kernel(ht_ref, w3t_ref, b3t_ref, bt_ref, xt_ref, o_ref, *,
                    P, O, Q, F, cb, precision):
     c0 = pl.program_id(1)
     rt = jax.lax.dot_general(
         w3t_ref[:], ht_ref[:],
         dimension_numbers=(((1,), (0,)), ((), ())),
         precision=precision,
-        preferred_element_type=jnp.float32)          # [cb*F*O, E_b]
+        preferred_element_type=jnp.float32) + b3t_ref[:]  # [cb*F*O, E_b]
     for p in range(P):
         acc = None
         for il in range(cb * F):
@@ -427,7 +459,8 @@ def _pick_blocks_bx(E: int, C: int, O: int, P: int, Q: int, F: int,
     multiple of 8 (so the xt row-block cb*Q and w3t row-block cb*F*O are
     tile-aligned for any odd Q/F) or the full (padded) C."""
     def _vmem(be, cb):
-        return 4 * (mid * be + cb * F * O * mid + 2 * cb * F * O * be
+        return 4 * (mid * be + cb * F * O * mid + cb * F * O * 128
+                    + 2 * cb * F * O * be
                     + P * F * Q * be + cb * Q * be + P * O * be)
 
     ov = _block_overrides('SE3_TPU_BLOCK_E', 'SE3_TPU_BLOCK_CB')
@@ -442,11 +475,12 @@ def _pick_blocks_bx(E: int, C: int, O: int, P: int, Q: int, F: int,
         while True:
             ht = mid * block_e
             w3 = cb * F * O * mid
+            b3 = cb * F * O * 128  # [S, 1] bias column, lanes pad to 128
             rt = cb * F * O * block_e
             bt = P * F * Q * block_e
             xt = cb * Q * block_e
             out = P * O * block_e
-            total = 4 * (ht + w3 + 2 * rt + bt + xt + out)
+            total = 4 * (ht + w3 + b3 + 2 * rt + bt + xt + out)
             if total <= vmem_budget:
                 return block_e, cb
             if cb <= 8:
@@ -468,7 +502,7 @@ def _pick_blocks_bx(E: int, C: int, O: int, P: int, Q: int, F: int,
     return 128, 8
 
 
-def _fused_pairwise_conv_bx_impl(h, w3, basis, x, interpret, precision,
+def _fused_pairwise_conv_bx_impl(h, w3, b3, basis, x, interpret, precision,
                                  pqf=None):
     """basis is [E, P, Q, F] (structured), or — when `pqf`=(P, Q, F) is
     given — [E, P*F*Q] pre-flattened in (p, f, q) order (the layout
@@ -500,6 +534,7 @@ def _fused_pairwise_conv_bx_impl(h, w3, basis, x, interpret, precision,
         else basis.transpose(1, 3, 2, 0).reshape(P * F * Q, E)
     xt = x.transpose(1, 2, 0).reshape(C * Q, E)
     w3t = w3.reshape(mid, C * F * O).T                # [(c,f,o), mid]
+    b3t = _bias_column(b3, C * F, O, Cp * F)
     if Cp != C:
         xt = jnp.pad(xt, ((0, (Cp - C) * Q), (0, 0)))
         w3t = jnp.pad(w3t, ((0, (Cp - C) * F * O), (0, 0)))
@@ -519,6 +554,8 @@ def _fused_pairwise_conv_bx_impl(h, w3, basis, x, interpret, precision,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((cb * F * O, mid), lambda e, c: (c, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((cb * F * O, 1), lambda e, c: (c, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec((P * F * Q, block_e), lambda e, c: (0, e),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((cb * Q, block_e), lambda e, c: (c, e),
@@ -528,7 +565,7 @@ def _fused_pairwise_conv_bx_impl(h, w3, basis, x, interpret, precision,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((P * O, Ep), jnp.float32),
         interpret=interpret,
-    )(ht, w3t, bt, xt)
+    )(ht, w3t, b3t, bt, xt)
 
     return outt.reshape(P, O, Ep).transpose(2, 0, 1)[:E]
 
@@ -536,11 +573,12 @@ def _fused_pairwise_conv_bx_impl(h, w3, basis, x, interpret, precision,
 @functools.lru_cache(maxsize=None)
 def _bx_partitioned(interpret, precision):
     return _make_partitioned(
-        lambda h, w3, basis, x: _fused_pairwise_conv_bx_impl(
-            h, w3, basis, x, interpret, precision),
-        rule='e m, m i o, e p q f, e c q -> e p o',
+        lambda h, w3, b3, basis, x: _fused_pairwise_conv_bx_impl(
+            h, w3, b3, basis, x, interpret, precision),
+        rule='e m, m i o, i o, e p q f, e c q -> e p o',
         need_repl=('m', 'i', 'q', 'f', 'c'),
         arg_specs=lambda P_, e, o: (P_(e, None), P_(None, None, o),
+                                    P_(None, o),
                                     P_(e, None, None, None),
                                     P_(e, None, None)),
         result_specs=lambda P_, e, o: (P_(e, None, o),))
@@ -549,27 +587,31 @@ def _bx_partitioned(interpret, precision):
 @functools.partial(jax.jit, static_argnames=('interpret', 'precision'))
 def fused_pairwise_conv_bx(h: jnp.ndarray, w3: jnp.ndarray,
                            basis: jnp.ndarray, x: jnp.ndarray,
+                           b3: jnp.ndarray = None,
                            interpret: bool = False,
                            precision=None) -> jnp.ndarray:
     """Basis-fused forward: h [E, mid], w3 [mid, C*F, O] (i=(c,f)
-    c-major), basis [E, P, Q, F], x [E, C, Q] -> out [E, P, O] (f32).
+    c-major), basis [E, P, Q, F], x [E, C, Q], b3 [C*F, O] (optional,
+    zeros when None) -> out [E, P, O] (f32).
 
-    Equals fused_pairwise_conv(h, w3, einsum('epqf,ecq->e p (c f)', ...))
-    without ever materializing that V2 tensor in HBM. Bias folding is the
-    caller's job, as in fused_pairwise_conv. Partitions over sharded
-    edge/output-channel axes (see the SPMD rules above).
+    Equals fused_pairwise_conv(h, w3, einsum('epqf,ecq->e p (c f)', ...),
+    b3) without ever materializing that V2 tensor in HBM. Partitions over
+    sharded edge/output-channel axes (see the SPMD rules above).
     """
-    return _bx_partitioned(interpret, precision)(h, w3, basis, x)
+    if b3 is None:
+        b3 = jnp.zeros(w3.shape[1:], jnp.float32)
+    return _bx_partitioned(interpret, precision)(h, w3, b3, basis, x)
 
 
 @functools.lru_cache(maxsize=None)
 def _bxf_partitioned(pqf, interpret, precision):
     return _make_partitioned(
-        lambda h, w3, basis, x: _fused_pairwise_conv_bx_impl(
-            h, w3, basis, x, interpret, precision, pqf=pqf),
-        rule='e m, m i o, e z, e c q -> e p o',
+        lambda h, w3, b3, basis, x: _fused_pairwise_conv_bx_impl(
+            h, w3, b3, basis, x, interpret, precision, pqf=pqf),
+        rule='e m, m i o, i o, e z, e c q -> e p o',
         need_repl=('m', 'i', 'z', 'c', 'q'),
         arg_specs=lambda P_, e, o: (P_(e, None), P_(None, None, o),
+                                    P_(None, o),
                                     P_(e, None), P_(e, None, None)),
         result_specs=lambda P_, e, o: (P_(e, None, o),))
 
@@ -578,7 +620,8 @@ def _bxf_partitioned(pqf, interpret, precision):
                    static_argnames=('pqf', 'interpret', 'precision'))
 def fused_pairwise_conv_bxf(h: jnp.ndarray, w3: jnp.ndarray,
                             basis_flat: jnp.ndarray, x: jnp.ndarray,
-                            pqf: tuple, interpret: bool = False,
+                            pqf: tuple, b3: jnp.ndarray = None,
+                            interpret: bool = False,
                             precision=None) -> jnp.ndarray:
     """fused_pairwise_conv_bx with the basis pre-flattened per edge to
     [E, P*F*Q] in (p, f, q) order (get_basis layout='pfq_flat'). Same
@@ -586,32 +629,38 @@ def fused_pairwise_conv_bxf(h: jnp.ndarray, w3: jnp.ndarray,
     structured [.., P, Q, F] form tile-pads its two small odd minor axes
     to (8, 128), the flat form pads one axis to the next 128 multiple.
     pqf = (P, Q, F) static ints."""
+    if b3 is None:
+        b3 = jnp.zeros(w3.shape[1:], jnp.float32)
     return _bxf_partitioned(tuple(pqf), interpret, precision)(
-        h, w3, basis_flat, x)
+        h, w3, b3, basis_flat, x)
 
 
 # --------------------------------------------------------------------- #
 # fused backward
 # --------------------------------------------------------------------- #
-# Cotangents of out[e,P,o] = sum_{if} V2[e,P,if] (H W3)[e,if,o]:
+# Cotangents of out[e,P,o] = sum_{if} V2[e,P,if] R[e,if,o],
+# R = H W3 + B3:
 #   dV2[e,P,if] = sum_o  g[e,P,o]  R[e,if,o]
 #   dR [e,if,o] = sum_P  V2[e,P,if] g[e,P,o]
 #   dH [e,m]    = sum_{if,o} dR[e,if,o] W3[m,if,o]
 #   dW3[m,if,o] = sum_e  H[e,m] dR[e,if,o]
-# Kernel A (grid (n_if, n_e), e inner): rT matmul -> dV2 rows (sublane
-# reduce), dR blocks -> dW3 accumulated over the inner edge axis.
+#   dB3[if,o]   = sum_e  dR[e,if,o]
+# Kernel A (grid (n_if, n_e), e inner): rT matmul (+bias) -> dV2 rows
+# (sublane reduce), dR blocks -> dW3 (matmul) and dB3 (lane reduce),
+# both accumulated over the inner edge axis.
 # Kernel B (grid (n_e, n_if), f inner): dR blocks (no matmul needed)
 # -> dH accumulated over the inner if axis.
 
 
-def _bwd_a_kernel(ht_ref, h_ref, w3t_ref, v2t_ref, gt_ref,
-                  dv2_ref, dw3_ref, *, P, O, bif, precision):
+def _bwd_a_kernel(ht_ref, h_ref, w3t_ref, b3t_ref, v2t_ref, gt_ref,
+                  dv2_ref, dw3_ref, db3_ref, *, P, O, bif, precision):
     e = pl.program_id(1)
+    # R must include the bias here: dV2 = g . R
     rt = jax.lax.dot_general(
         w3t_ref[:], ht_ref[:],
         dimension_numbers=(((1,), (0,)), ((), ())),
         precision=precision,
-        preferred_element_type=jnp.float32)          # [bif*O, E_b]
+        preferred_element_type=jnp.float32) + b3t_ref[:]  # [bif*O, E_b]
     g = gt_ref[:]                                    # [P*O, E_b]
     for i in range(bif):
         r_i = rt[i * O:(i + 1) * O, :]               # [O, E_b]
@@ -631,15 +680,20 @@ def _bwd_a_kernel(ht_ref, h_ref, w3t_ref, v2t_ref, gt_ref,
             dimension_numbers=(((1,), (0,)), ((), ())),
             precision=precision,
             preferred_element_type=jnp.float32)      # [O, mid]
+        # dB3 rows: sum dR over edges (lane reduction), same revisit
+        # accumulation. Padded edge lanes contribute zeros (v2/g padded).
+        db3_upd = jnp.sum(dr_i, axis=1, keepdims=True)   # [O, 1]
         sl = slice(i * O, (i + 1) * O)
 
         @pl.when(e == 0)
-        def _(upd=upd, sl=sl):
+        def _(upd=upd, db3_upd=db3_upd, sl=sl):
             dw3_ref[sl, :] = upd.astype(dw3_ref.dtype)
+            db3_ref[sl, :] = db3_upd.astype(db3_ref.dtype)
 
         @pl.when(e > 0)
-        def _(upd=upd, sl=sl):
+        def _(upd=upd, db3_upd=db3_upd, sl=sl):
             dw3_ref[sl, :] = dw3_ref[sl, :] + upd.astype(dw3_ref.dtype)
+            db3_ref[sl, :] = db3_ref[sl, :] + db3_upd.astype(db3_ref.dtype)
 
 
 def _bwd_b_kernel(w3f_ref, v2t_ref, gt_ref, dh_ref, *, P, O, bif,
@@ -670,7 +724,7 @@ def _bwd_b_kernel(w3f_ref, v2t_ref, gt_ref, dh_ref, *, P, O, bif,
         dh_ref[:] = dh_ref[:] + acc.astype(dh_ref.dtype)
 
 
-def _fused_pairwise_conv_bwd_impl(h, w3, v2, g, interpret, precision):
+def _fused_pairwise_conv_bwd_impl(h, w3, b3, v2, g, interpret, precision):
     h, w3 = h.astype(jnp.float32), w3.astype(jnp.float32)
     E, mid = h.shape
     _, IF, O = w3.shape
@@ -680,6 +734,7 @@ def _fused_pairwise_conv_bwd_impl(h, w3, v2, g, interpret, precision):
     Ep, IFp = _round_up(E, block_e), _round_up(IF, block_if)
 
     ht, w3t, v2t, gt = _to_lanes(h, w3, v2, g)
+    b3t = _bias_column(b3, IF, O, IFp)
     h_p, w3f = h, w3.reshape(mid, IF * O)
     if Ep != E:
         ht = jnp.pad(ht, ((0, 0), (0, Ep - E)))
@@ -693,8 +748,8 @@ def _fused_pairwise_conv_bwd_impl(h, w3, v2, g, interpret, precision):
 
     n_e, n_if = Ep // block_e, IFp // block_if
 
-    # kernel A: dV2 + dW3 (accumulate over inner e axis)
-    dv2t, dw3t = pl.pallas_call(
+    # kernel A: dV2 + dW3 + dB3 (accumulate over inner e axis)
+    dv2t, dw3t, db3t = pl.pallas_call(
         functools.partial(_bwd_a_kernel, P=P, O=O, bif=block_if,
                           precision=precision),
         grid=(n_if, n_e),
@@ -704,6 +759,8 @@ def _fused_pairwise_conv_bwd_impl(h, w3, v2, g, interpret, precision):
             pl.BlockSpec((block_e, mid), lambda f, e: (e, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((block_if * O, mid), lambda f, e: (f, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_if * O, 1), lambda f, e: (f, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((P, block_if, block_e), lambda f, e: (0, f, e),
                          memory_space=pltpu.VMEM),
@@ -715,13 +772,16 @@ def _fused_pairwise_conv_bwd_impl(h, w3, v2, g, interpret, precision):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((block_if * O, mid), lambda f, e: (f, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_if * O, 1), lambda f, e: (f, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((P, IFp, Ep), jnp.float32),
             jax.ShapeDtypeStruct((IFp * O, mid), jnp.float32),
+            jax.ShapeDtypeStruct((IFp * O, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(ht, h_p, w3t, v2t, gt)
+    )(ht, h_p, w3t, b3t, v2t, gt)
 
     # kernel B: dH (accumulate over inner if axis; no matmul with w3T
     # needed — dR comes straight from v2/g). The if-chunk axis of w3 rides
@@ -749,45 +809,54 @@ def _fused_pairwise_conv_bwd_impl(h, w3, v2, g, interpret, precision):
     dh = dht.T[:E]
     dw3 = dw3t.reshape(IFp, O, mid).transpose(2, 0, 1)[:, :IF]
     dv2 = dv2t.transpose(2, 0, 1)[:E, :, :IF]
-    return dh, dw3, dv2
+    db3 = db3t.reshape(IFp, O)[:IF]
+    return dh, dw3, dv2, db3
 
 
 def _bwd_psums(outs, e, o):
-    dh, dw3, dv2 = outs
-    # dW3 sums over edges (sharded e axes); dH/dV2 sum over the output
+    dh, dw3, dv2, db3 = outs
+    # dW3/dB3 sum over edges (sharded e axes); dH/dV2 sum over the output
     # channels (sharded o axes under tensor parallelism)
     if _axis_tuple(e):
         dw3 = jax.lax.psum(dw3, _axis_tuple(e))
+        db3 = jax.lax.psum(db3, _axis_tuple(e))
     if _axis_tuple(o):
         dh = jax.lax.psum(dh, _axis_tuple(o))
         dv2 = jax.lax.psum(dv2, _axis_tuple(o))
-    return dh, dw3, dv2
+    return dh, dw3, dv2, db3
 
 
 @functools.lru_cache(maxsize=None)
 def _bwd_partitioned(interpret, precision):
     return _make_partitioned(
-        lambda h, w3, v2, g: _fused_pairwise_conv_bwd_impl(
-            h, w3, v2, g, interpret, precision),
-        rule='e m, m k o, e p k, e p o -> e m, m k o, e p k',
+        lambda h, w3, b3, v2, g: _fused_pairwise_conv_bwd_impl(
+            h, w3, b3, v2, g, interpret, precision),
+        rule='e m, m k o, k o, e p k, e p o -> e m, m k o, e p k, k o',
         need_repl=('m', 'k'),
         arg_specs=lambda P_, e, o: (P_(e, None), P_(None, None, o),
+                                    P_(None, o),
                                     P_(e, None, None), P_(e, None, o)),
         result_specs=lambda P_, e, o: (P_(e, None), P_(None, None, o),
-                                       P_(e, None, None)),
+                                       P_(e, None, None), P_(None, o)),
         psum_fn=_bwd_psums)
 
 
 @functools.partial(jax.jit, static_argnames=('interpret', 'precision'))
 def fused_pairwise_conv_bwd(h: jnp.ndarray, w3: jnp.ndarray,
                             v2: jnp.ndarray, g: jnp.ndarray,
+                            b3: jnp.ndarray = None,
                             interpret: bool = False, precision=None):
-    """Backward of fused_pairwise_conv: returns (dh, dw3, dv2), all f32.
+    """Backward of fused_pairwise_conv: returns (dh, dw3, dv2, db3),
+    all f32.
 
-    h [E, mid], w3 [mid, IF, O], v2 [E, P, IF], g [E, P, O].
+    h [E, mid], w3 [mid, IF, O], v2 [E, P, IF], g [E, P, O], b3 [IF, O]
+    (optional, zeros when None — b3 feeds dV2 = g . R with R including
+    the bias; db3 itself is bias-independent: sum_e dR).
     bf16 radial operands are upcast (exactly) and the backward runs in
     f32 — gradients stay at the policy precision under radial_bf16.
-    Partitions over sharded edge/output-channel axes with the dW3 (and,
-    under tp, dH/dV2) partial sums reduced in the partition body.
+    Partitions over sharded edge/output-channel axes with the dW3/dB3
+    (and, under tp, dH/dV2) partial sums reduced in the partition body.
     """
-    return _bwd_partitioned(interpret, precision)(h, w3, v2, g)
+    if b3 is None:
+        b3 = jnp.zeros(w3.shape[1:], jnp.float32)
+    return _bwd_partitioned(interpret, precision)(h, w3, b3, v2, g)

@@ -133,44 +133,47 @@ def _stream_node_chunks(contract, operands, edge_chunks: int):
     return out[:, :n] if n_pad != n else out
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _pairwise_contract_pallas(h, w3b, v2, interpret=False, precision=None):
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _pairwise_contract_pallas(h, w3, b3, v2, interpret=False,
+                              precision=None):
     from ..kernels.pallas_pairwise import fused_pairwise_conv
-    return fused_pairwise_conv(h, w3b, v2, interpret=interpret,
+    return fused_pairwise_conv(h, w3, v2, b3=b3, interpret=interpret,
                                precision=precision)
 
 
-def _pc_fwd(h, w3b, v2, interpret=False, precision=None):
-    return (_pairwise_contract_pallas(h, w3b, v2, interpret, precision),
-            (h, w3b, v2))
+def _pc_fwd(h, w3, b3, v2, interpret=False, precision=None):
+    return (_pairwise_contract_pallas(h, w3, b3, v2, interpret, precision),
+            (h, w3, b3, v2))
 
 
 def _pc_bwd(interpret, precision, res, g):
     # fused backward kernel: dR/R exist only as VMEM chunks (see
     # kernels.pallas_pairwise.fused_pairwise_conv_bwd)
     from ..kernels.pallas_pairwise import fused_pairwise_conv_bwd
-    h, w3b, v2 = res
-    dh, dw3, dv2 = fused_pairwise_conv_bwd(h, w3b, v2, g,
-                                           interpret=interpret,
-                                           precision=precision)
-    return (dh.astype(h.dtype), dw3.astype(w3b.dtype), dv2.astype(v2.dtype))
+    h, w3, b3, v2 = res
+    dh, dw3, dv2, db3 = fused_pairwise_conv_bwd(h, w3, v2, g, b3=b3,
+                                                interpret=interpret,
+                                                precision=precision)
+    return (dh.astype(h.dtype), dw3.astype(w3.dtype), db3.astype(b3.dtype),
+            dv2.astype(v2.dtype))
 
 
 _pairwise_contract_pallas.defvjp(_pc_fwd, _pc_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _pairwise_contract_pallas_bx(h, w3b, basis, x, interpret=False,
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _pairwise_contract_pallas_bx(h, w3, b3, basis, x, interpret=False,
                                  precision=None):
     from ..kernels.pallas_pairwise import fused_pairwise_conv_bx
-    return fused_pairwise_conv_bx(h, w3b, basis, x, interpret=interpret,
+    return fused_pairwise_conv_bx(h, w3, basis, x, b3=b3,
+                                  interpret=interpret,
                                   precision=precision)
 
 
-def _pc_bx_fwd(h, w3b, basis, x, interpret=False, precision=None):
-    return (_pairwise_contract_pallas_bx(h, w3b, basis, x, interpret,
+def _pc_bx_fwd(h, w3, b3, basis, x, interpret=False, precision=None):
+    return (_pairwise_contract_pallas_bx(h, w3, b3, basis, x, interpret,
                                          precision),
-            (h, w3b, basis, x))
+            (h, w3, b3, basis, x))
 
 
 def _pc_bx_bwd(interpret, precision, res, g):
@@ -179,37 +182,37 @@ def _pc_bx_bwd(interpret, precision, res, g):
     # cotangent back through the basis contraction (dbasis feeds
     # coordinate gradients when differentiable_coors is on).
     from ..kernels.pallas_pairwise import fused_pairwise_conv_bwd
-    h, w3b, basis, x = res
+    h, w3, b3, basis, x = res
     E, P, Q, F = basis.shape
     C = x.shape[1]
     v2 = jnp.einsum('epqf,ecq->epcf', basis, x,
                     precision=precision).reshape(E, P, C * F)
-    dh, dw3, dv2 = fused_pairwise_conv_bwd(h, w3b, v2, g,
-                                           interpret=interpret,
-                                           precision=precision)
+    dh, dw3, dv2, db3 = fused_pairwise_conv_bwd(h, w3, v2, g, b3=b3,
+                                                interpret=interpret,
+                                                precision=precision)
     dv2 = dv2.reshape(E, P, C, F)
     dx = jnp.einsum('epqf,epcf->ecq', basis, dv2, precision=precision)
     dbasis = jnp.einsum('ecq,epcf->epqf', x, dv2, precision=precision)
-    return (dh.astype(h.dtype), dw3.astype(w3b.dtype),
+    return (dh.astype(h.dtype), dw3.astype(w3.dtype), db3.astype(b3.dtype),
             dbasis.astype(basis.dtype), dx.astype(x.dtype))
 
 
 _pairwise_contract_pallas_bx.defvjp(_pc_bx_fwd, _pc_bx_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _pairwise_contract_pallas_bxf(h, w3b, basis_flat, x, pqf,
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _pairwise_contract_pallas_bxf(h, w3, b3, basis_flat, x, pqf,
                                   interpret=False, precision=None):
     from ..kernels.pallas_pairwise import fused_pairwise_conv_bxf
-    return fused_pairwise_conv_bxf(h, w3b, basis_flat, x, pqf,
+    return fused_pairwise_conv_bxf(h, w3, basis_flat, x, pqf, b3=b3,
                                    interpret=interpret, precision=precision)
 
 
-def _pc_bxf_fwd(h, w3b, basis_flat, x, pqf, interpret=False,
+def _pc_bxf_fwd(h, w3, b3, basis_flat, x, pqf, interpret=False,
                 precision=None):
-    return (_pairwise_contract_pallas_bxf(h, w3b, basis_flat, x, pqf,
+    return (_pairwise_contract_pallas_bxf(h, w3, b3, basis_flat, x, pqf,
                                           interpret, precision),
-            (h, w3b, basis_flat, x))
+            (h, w3, b3, basis_flat, x))
 
 
 def _pc_bxf_bwd(pqf, interpret, precision, res, g):
@@ -218,21 +221,21 @@ def _pc_bxf_bwd(pqf, interpret, precision, res, g):
     # that form, so the ~60x tile-padded [E, P, Q, F] buffer never
     # materializes in the backward either.
     from ..kernels.pallas_pairwise import fused_pairwise_conv_bwd
-    h, w3b, basis_flat, x = res
+    h, w3, b3, basis_flat, x = res
     P, Q, F = pqf
     E = basis_flat.shape[0]
     C = x.shape[1]
     b4 = basis_flat.reshape(E, P, F, Q)
     v2 = jnp.einsum('epfq,ecq->epcf', b4, x,
                     precision=precision).reshape(E, P, C * F)
-    dh, dw3, dv2 = fused_pairwise_conv_bwd(h, w3b, v2, g,
-                                           interpret=interpret,
-                                           precision=precision)
+    dh, dw3, dv2, db3 = fused_pairwise_conv_bwd(h, w3, v2, g, b3=b3,
+                                                interpret=interpret,
+                                                precision=precision)
     dv2 = dv2.reshape(E, P, C, F)
     dx = jnp.einsum('epfq,epcf->ecq', b4, dv2, precision=precision)
     dbasis = jnp.einsum('ecq,epcf->epfq', x, dv2,
                         precision=precision).reshape(E, P * F * Q)
-    return (dh.astype(h.dtype), dw3.astype(w3b.dtype),
+    return (dh.astype(h.dtype), dw3.astype(w3.dtype), db3.astype(b3.dtype),
             dbasis.astype(basis_flat.dtype), dx.astype(x.dtype))
 
 
@@ -355,11 +358,14 @@ def _radial_contract(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
     O = w3.shape[-1]
 
     if _use_pallas(pallas, pallas_interpret):
-        # fold bias once: ones column on h (appended per chunk), bias row
-        # on w3. Capture the active matmul-precision policy at trace time:
-        # the custom_vjp backward traces outside the model's
-        # default_matmul_precision context, so it must be threaded in.
-        w3b = jnp.concatenate([w3, b3[None]], axis=0).astype(h.dtype)
+        # The bias rides as its own [S, 1] kernel operand — folding it
+        # (ones column on h, bias row on w3) made the contraction dim
+        # mid+1 = 129 and cost a structural ~2x on the dominant MXU dot
+        # (kernels.pallas_pairwise docstring). Capture the active
+        # matmul-precision policy at trace time: the custom_vjp backward
+        # traces outside the model's default_matmul_precision context,
+        # so it must be threaded in.
+        w3c = w3.astype(h.dtype)
         prec = jax.config.jax_default_matmul_precision
 
         def contract(h_c, v2_c):
@@ -368,17 +374,17 @@ def _radial_contract(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
             for s in lead_c:
                 E *= s
             h2 = h_c.reshape(E, h_c.shape[-1])
-            h2 = jnp.concatenate([h2, jnp.ones((E, 1), h2.dtype)], axis=-1)
-            out = _pairwise_contract_pallas(h2, w3b, v2_c.reshape(E, P, IF),
+            out = _pairwise_contract_pallas(h2, w3c, b3,
+                                            v2_c.reshape(E, P, IF),
                                             pallas_interpret, prec)
             return out.reshape(*lead_c, P, O)
     else:
         def contract(h_c, v2_c):
-            # quantize the bias exactly as the Pallas path's folded row
-            # does, so both dispatch paths compute identical values
-            b3q = b3.astype(h_c.dtype).astype(jnp.float32)
+            # bias stays f32 (the Pallas path adds it to the f32
+            # accumulator), so both dispatch paths compute identical
+            # values even under radial_bf16
             R = jnp.einsum('...m,mko->...ko', h_c, w3.astype(h_c.dtype),
-                           preferred_element_type=jnp.float32) + b3q
+                           preferred_element_type=jnp.float32) + b3
             return jnp.einsum('...pk,...ko->...po', v2_c, R)
 
     if edge_chunks is None:
@@ -406,7 +412,8 @@ def _radial_contract_bx(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
         P, Q, F = basis.shape[-3:]
     C = x.shape[-2]
     O = w3.shape[-1]
-    w3b = jnp.concatenate([w3, b3[None]], axis=0).astype(h.dtype)
+    # bias un-folded: separate [S, 1] kernel operand (see _radial_contract)
+    w3c = w3.astype(h.dtype)
     prec = jax.config.jax_default_matmul_precision
 
     def contract(h_c, basis_c, x_c):
@@ -415,15 +422,14 @@ def _radial_contract_bx(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
         for s in lead_c:
             E *= s
         h2 = h_c.reshape(E, h_c.shape[-1])
-        h2 = jnp.concatenate([h2, jnp.ones((E, 1), h2.dtype)], axis=-1)
         if flat:
             out = _pairwise_contract_pallas_bxf(
-                h2, w3b, basis_c.reshape(E, P * F * Q),
+                h2, w3c, b3, basis_c.reshape(E, P * F * Q),
                 x_c.reshape(E, C, Q), (P, Q, F), pallas_interpret, prec)
         else:
             out = _pairwise_contract_pallas_bx(
-                h2, w3b, basis_c.reshape(E, P, Q, F), x_c.reshape(E, C, Q),
-                pallas_interpret, prec)
+                h2, w3c, b3, basis_c.reshape(E, P, Q, F),
+                x_c.reshape(E, C, Q), pallas_interpret, prec)
         return out.reshape(*lead_c, P, O)
 
     if edge_chunks is None:

@@ -25,8 +25,12 @@ from __future__ import annotations
 
 from .helpers import to_order
 
-# radial trunk width incl. the folded bias row (ops/conv.py)
-MID = 129
+# radial trunk width (ops/conv.py DEFAULT_MID_DIM). The bias is a
+# separate [S, 1] kernel operand since the round-4 un-folding (it used
+# to ride as a 129th contraction row — which the MXU padded to 256,
+# physically DOUBLING the dominant dot); its add is O(E*IF*O), counted
+# nowhere because it is <1% of the apply term it rides on.
+MID = 128
 
 # v5e per-chip peaks used for MFU reporting: ~197 TFLOP/s bf16 MXU;
 # f32 runs as 3-pass bf16 (~1/4 rate)

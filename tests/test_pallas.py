@@ -19,11 +19,18 @@ def test_fused_kernel_matches_einsum():
     E, mid, I, F, O, P = 37, 16, 5, 3, 12, 7
     h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
     w3 = jnp.asarray(rng.normal(size=(mid, I * F, O)), jnp.float32)
+    b3 = jnp.asarray(rng.normal(size=(I * F, O)), jnp.float32)
     v2 = jnp.asarray(rng.normal(size=(E, P, I * F)), jnp.float32)
 
-    out = fused_pairwise_conv(h, w3, v2, interpret=True)
-    ref = jnp.einsum('epk,eko->epo', v2, jnp.einsum('em,mko->eko', h, w3))
+    out = fused_pairwise_conv(h, w3, v2, b3=b3, interpret=True)
+    R = jnp.einsum('em,mko->eko', h, w3) + b3
+    ref = jnp.einsum('epk,eko->epo', v2, R)
     assert jnp.abs(out - ref).max() < 1e-4
+
+    # b3 omitted == zero bias
+    out0 = fused_pairwise_conv(h, w3, v2, interpret=True)
+    ref0 = jnp.einsum('epk,eko->epo', v2, jnp.einsum('em,mko->eko', h, w3))
+    assert jnp.abs(out0 - ref0).max() < 1e-4
 
 
 @pytest.mark.parametrize('d_in,d_out', [(0, 1), (1, 1), (2, 1)])
@@ -114,20 +121,24 @@ def test_fused_bwd_kernel_matches_einsum():
     IF = I * F
     h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
     w3 = jnp.asarray(rng.normal(size=(mid, IF, O)), jnp.float32)
+    b3 = jnp.asarray(rng.normal(size=(IF, O)), jnp.float32)
     v2 = jnp.asarray(rng.normal(size=(E, P, IF)), jnp.float32)
     g = jnp.asarray(rng.normal(size=(E, P, O)), jnp.float32)
 
-    dh, dw3, dv2 = fused_pairwise_conv_bwd(h, w3, v2, g, interpret=True)
+    dh, dw3, dv2, db3 = fused_pairwise_conv_bwd(h, w3, v2, g, b3=b3,
+                                                interpret=True)
 
-    R = jnp.einsum('em,mko->eko', h, w3)
+    R = jnp.einsum('em,mko->eko', h, w3) + b3  # dV2 needs R WITH bias
     dv2_ref = jnp.einsum('epo,eko->epk', g, R)
     dR = jnp.einsum('epk,epo->eko', v2, g)
     dh_ref = jnp.einsum('eko,mko->em', dR, w3)
     dw3_ref = jnp.einsum('em,eko->mko', h, dR)
+    db3_ref = dR.sum(0)
 
     assert jnp.abs(dv2 - dv2_ref).max() < 1e-3
     assert jnp.abs(dh - dh_ref).max() < 1e-3
     assert jnp.abs(dw3 - dw3_ref).max() < 1e-3
+    assert jnp.abs(db3 - db3_ref).max() < 1e-3
 
 
 def test_fused_kernels_multichunk_if_axis():
@@ -141,23 +152,27 @@ def test_fused_kernels_multichunk_if_axis():
     E, mid, IF, O, P = 17, 8, 280, 20, 5
     h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
     w3 = jnp.asarray(rng.normal(size=(mid, IF, O)), jnp.float32)
+    b3 = jnp.asarray(rng.normal(size=(IF, O)), jnp.float32)
     v2 = jnp.asarray(rng.normal(size=(E, P, IF)), jnp.float32)
     g = jnp.asarray(rng.normal(size=(E, P, O)), jnp.float32)
 
-    out = fused_pairwise_conv(h, w3, v2, interpret=True)
-    R = jnp.einsum('em,mko->eko', h, w3)
+    out = fused_pairwise_conv(h, w3, v2, b3=b3, interpret=True)
+    R = jnp.einsum('em,mko->eko', h, w3) + b3
     ref = jnp.einsum('epk,eko->epo', v2, R)
     assert jnp.abs(out - ref).max() / jnp.abs(ref).max() < 1e-5
 
-    dh, dw3, dv2 = fused_pairwise_conv_bwd(h, w3, v2, g, interpret=True)
+    dh, dw3, dv2, db3 = fused_pairwise_conv_bwd(h, w3, v2, g, b3=b3,
+                                                interpret=True)
     dv2_ref = jnp.einsum('epo,eko->epk', g, R)
     dR = jnp.einsum('epk,epo->eko', v2, g)
     dh_ref = jnp.einsum('eko,mko->em', dR, w3)
     dw3_ref = jnp.einsum('em,eko->mko', h, dR)
+    db3_ref = dR.sum(0)
     scale = lambda t: jnp.abs(t).max()
     assert jnp.abs(dv2 - dv2_ref).max() / scale(dv2_ref) < 1e-5
     assert jnp.abs(dh - dh_ref).max() / scale(dh_ref) < 1e-5
     assert jnp.abs(dw3 - dw3_ref).max() / scale(dw3_ref) < 1e-5
+    assert jnp.abs(db3 - db3_ref).max() / scale(db3_ref) < 1e-5
 
 
 @pytest.mark.parametrize('shape', [
@@ -177,21 +192,25 @@ def test_fused_kernels_shape_fuzz(shape):
     rng = np.random.RandomState(sum(shape))
     h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
     w3 = jnp.asarray(rng.normal(size=(mid, IF, O)), jnp.float32)
+    b3 = jnp.asarray(rng.normal(size=(IF, O)), jnp.float32)
     v2 = jnp.asarray(rng.normal(size=(E, P, IF)), jnp.float32)
     g = jnp.asarray(rng.normal(size=(E, P, O)), jnp.float32)
 
-    R = jnp.einsum('em,mko->eko', h, w3)
+    R = jnp.einsum('em,mko->eko', h, w3) + b3
     ref = jnp.einsum('epk,eko->epo', v2, R)
-    out = fused_pairwise_conv(h, w3, v2, interpret=True)
+    out = fused_pairwise_conv(h, w3, v2, b3=b3, interpret=True)
     scale = float(jnp.abs(ref).max()) + 1e-9
     assert jnp.abs(out - ref).max() / scale < 1e-5
 
-    dh, dw3, dv2 = fused_pairwise_conv_bwd(h, w3, v2, g, interpret=True)
+    dh, dw3, dv2, db3 = fused_pairwise_conv_bwd(h, w3, v2, g, b3=b3,
+                                                interpret=True)
     dv2_ref = jnp.einsum('epo,eko->epk', g, R)
     dR = jnp.einsum('epk,epo->eko', v2, g)
     dh_ref = jnp.einsum('eko,mko->em', dR, w3)
     dw3_ref = jnp.einsum('em,eko->mko', h, dR)
-    for a, b in ((dh, dh_ref), (dw3, dw3_ref), (dv2, dv2_ref)):
+    db3_ref = dR.sum(0)
+    for a, b in ((dh, dh_ref), (dw3, dw3_ref), (dv2, dv2_ref),
+                 (db3, db3_ref)):
         s = float(jnp.abs(b).max()) + 1e-9
         assert jnp.abs(a - b).max() / s < 1e-5, shape
 
@@ -436,12 +455,14 @@ def test_fused_bx_kernel_matches_einsum(shape):
     rng = np.random.RandomState(sum(shape))
     h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
     w3 = jnp.asarray(rng.normal(size=(mid, C * F, O)), jnp.float32)
+    b3 = jnp.asarray(rng.normal(size=(C * F, O)), jnp.float32)
     basis = jnp.asarray(rng.normal(size=(E, P, Q, F)), jnp.float32)
     x = jnp.asarray(rng.normal(size=(E, C, Q)), jnp.float32)
 
-    out = fused_pairwise_conv_bx(h, w3, basis, x, interpret=True)
+    out = fused_pairwise_conv_bx(h, w3, basis, x, b3=b3, interpret=True)
     v2 = jnp.einsum('epqf,ecq->epcf', basis, x).reshape(E, P, C * F)
-    ref = jnp.einsum('epk,eko->epo', v2, jnp.einsum('em,mko->eko', h, w3))
+    R = jnp.einsum('em,mko->eko', h, w3) + b3
+    ref = jnp.einsum('epk,eko->epo', v2, R)
     scale = float(jnp.abs(ref).max()) + 1e-9
     assert jnp.abs(out - ref).max() / scale < 1e-5, shape
 
@@ -559,12 +580,13 @@ def test_bxf_kernel_matches_bx():
     P, Q, F = 5, 3, 3
     h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
     w3 = jnp.asarray(rng.normal(size=(mid, C * F, O)), jnp.float32)
+    b3 = jnp.asarray(rng.normal(size=(C * F, O)), jnp.float32)
     basis = jnp.asarray(rng.normal(size=(E, P, Q, F)), jnp.float32)
     x = jnp.asarray(rng.normal(size=(E, C, Q)), jnp.float32)
     flat = jnp.swapaxes(basis, -1, -2).reshape(E, P * F * Q)
 
-    out_bx = fused_pairwise_conv_bx(h, w3, basis, x, interpret=True)
-    out_bxf = fused_pairwise_conv_bxf(h, w3, flat, x, (P, Q, F),
+    out_bx = fused_pairwise_conv_bx(h, w3, basis, x, b3=b3, interpret=True)
+    out_bxf = fused_pairwise_conv_bxf(h, w3, flat, x, (P, Q, F), b3=b3,
                                       interpret=True)
     assert np.abs(np.asarray(out_bx) - np.asarray(out_bxf)).max() < 1e-5
 
@@ -572,18 +594,19 @@ def test_bxf_kernel_matches_bx():
     from se3_transformer_tpu.ops.conv import (
         _pairwise_contract_pallas_bx, _pairwise_contract_pallas_bxf,
     )
-    loss_bx = lambda h, b, x: (_pairwise_contract_pallas_bx(  # noqa: E731
-        h, w3, b, x, True, None) ** 2).sum()
-    loss_bxf = lambda h, b, x: (_pairwise_contract_pallas_bxf(  # noqa: E731
-        h, w3, b, x, (P, Q, F), True, None) ** 2).sum()
-    g_bx = jax.grad(loss_bx, argnums=(0, 1, 2))(h, basis, x)
-    g_bxf = jax.grad(loss_bxf, argnums=(0, 1, 2))(h, flat, x)
+    loss_bx = lambda h, bb, b, x: (_pairwise_contract_pallas_bx(  # noqa: E731
+        h, w3, bb, b, x, True, None) ** 2).sum()
+    loss_bxf = lambda h, bb, b, x: (_pairwise_contract_pallas_bxf(  # noqa: E731,E501
+        h, w3, bb, b, x, (P, Q, F), True, None) ** 2).sum()
+    g_bx = jax.grad(loss_bx, argnums=(0, 1, 2, 3))(h, b3, basis, x)
+    g_bxf = jax.grad(loss_bxf, argnums=(0, 1, 2, 3))(h, b3, flat, x)
     assert np.abs(np.asarray(g_bx[0]) - np.asarray(g_bxf[0])).max() < 1e-4
+    assert np.abs(np.asarray(g_bx[1]) - np.asarray(g_bxf[1])).max() < 1e-4
     g_basis_back = jnp.swapaxes(
-        g_bxf[1].reshape(E, P, F, Q), -1, -2)  # (p,f,q) -> (p,q,f)
-    assert np.abs(np.asarray(g_bx[1]) - np.asarray(g_basis_back)).max() \
+        g_bxf[2].reshape(E, P, F, Q), -1, -2)  # (p,f,q) -> (p,q,f)
+    assert np.abs(np.asarray(g_bx[2]) - np.asarray(g_basis_back)).max() \
         < 1e-4
-    assert np.abs(np.asarray(g_bx[2]) - np.asarray(g_bxf[2])).max() < 1e-4
+    assert np.abs(np.asarray(g_bx[3]) - np.asarray(g_bxf[3])).max() < 1e-4
 
 
 def test_model_flat_basis_matches_structured():

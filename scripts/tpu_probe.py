@@ -31,13 +31,25 @@ def min_real_step_ms(n: int) -> float:
     return max(30.0, 300.0 * n / 1024.0)
 
 
-def package_fingerprint():
+def package_fingerprint(ignore_env: bool = False):
     """Tree hash of the package directory at HEAD — the identity under
     which probe measurements stay valid. Docs/scripts commits don't
     disturb it; any package code change retires prior records from the
     --skip-done set and the batch election (uncommitted package edits
     are invisible to it, so probe sessions must run from a committed
-    tree — the session loop always does)."""
+    tree — the session loop always does).
+
+    SE3_TPU_CODE_REV overrides the git lookup: a commit landing while a
+    long-lived session is mid-stage-order would otherwise stamp
+    measurements of the already-loaded old code with the new tree hash
+    (observed 15:42Z round 4: the bias-unfolding commit landed while the
+    pre-change session ran). tpu_session pins it at chip acquisition —
+    computed via ignore_env=True (a stale env from the launching shell
+    must not win) — and eagerly imports the package in the same breath
+    so the pinned rev IS the loaded code."""
+    env = None if ignore_env else os.environ.get('SE3_TPU_CODE_REV')
+    if env:
+        return env
     try:
         return subprocess.run(
             ['git', 'rev-parse', 'HEAD:se3_transformer_tpu'],

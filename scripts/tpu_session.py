@@ -143,6 +143,19 @@ def main():
     sys.path.insert(0, os.path.dirname(here))  # repo root (bench, package)
     sys.path.insert(0, here)                   # scripts/ (tpu_checks)
 
+    # pin the probe fingerprint to the code THIS session loads: a commit
+    # landing mid-session must not relabel old-code measurements with the
+    # new tree hash. ignore_env: a stale SE3_TPU_CODE_REV inherited from
+    # the launching shell must not win over the real git lookup. The
+    # eager package import in the same breath makes the pinned rev the
+    # code actually in memory for every later stage.
+    import tpu_probe
+    rev = tpu_probe.package_fingerprint(ignore_env=True)
+    if rev:
+        os.environ['SE3_TPU_CODE_REV'] = rev
+        log(f'code_rev pinned: {rev}')
+    import se3_transformer_tpu  # noqa: F401 - eager load at the pinned rev
+
     # persist compiles across session relaunches: the tunnel can die
     # mid-session and every recompile over it costs minutes
     from se3_transformer_tpu.utils.compilation_cache import (

@@ -3,11 +3,21 @@
 The _pick_blocks/_pick_blocks_bx defaults were chosen from a VMEM model,
 never from measurement (VERDICT r2 weak #3). This sweep times
 fused_pairwise_conv (+ the bx variant) at flagship-relevant shapes
-across block settings, one SUBPROCESS per setting — the jit cache keys
-on shapes/statics, not env, so in-process env flips would silently
-reuse the first compilation.
+across block settings.
 
-Writes crash-safe JSONL; run on the chip via a free tunnel only.
+TWO execution modes, auto-detected:
+- **in-process** (the tpu_session stage): the axon tunnel is
+  SINGLE-CLIENT, so when this process already holds an initialized
+  backend, a per-setting subprocess would block at jax init against our
+  own claim until its timeout — 16 settings x 1800 s of wedged chip
+  (the round-4 near-miss). Instead the sweep flips the env overrides
+  in-process and calls `.clear_cache()` on the jit entry points between
+  settings, forcing a re-trace that re-reads the env (the jit cache
+  keys on shapes/statics, not env).
+- **subprocess** (standalone, free tunnel): one child per setting, the
+  conservative original design.
+
+Writes crash-safe JSONL.
 
 Usage: python scripts/kernel_tune.py [--out KERNEL_TUNE.jsonl]
        [--iters 30] [--block-e 128 256 512] [--block-if 8 16 32]
@@ -17,6 +27,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
@@ -75,6 +86,114 @@ print(json.dumps(dict(kind=kind, blocks=list(blocks), ms=round(ms, 3),
 '''
 
 
+def _backend_initialized_here() -> bool:
+    """True when THIS process already holds an initialized jax backend —
+    the single-client tunnel then forbids subprocess children (they
+    would block at init against our own claim)."""
+    if 'jax' not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge
+        return bool(xla_bridge._backends)
+    except Exception:  # noqa: BLE001
+        # jax is imported but the private registry moved (jax upgrade):
+        # assume HELD — the in-process path is always safe, while a wrong
+        # subprocess choice wedges the single-client tunnel 16x1800s
+        return True
+
+
+def _run_inprocess(args, settings):
+    import inspect
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from se3_transformer_tpu.kernels import pallas_pairwise as pp
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    enable_compilation_cache()
+    backend = jax.default_backend()
+    rng = np.random.RandomState(0)
+
+    # the loaded kernels may predate the bias un-folding (a long-lived
+    # session imports the package once; the sweep then measures the OLD
+    # kernel — honest data, flagged in the record)
+    has_b3 = 'b3' in inspect.signature(pp.fused_pairwise_conv).parameters
+    mid = 128 if has_b3 else 129
+
+    E, IF, O, P = 32768, 1024, 64, 7
+    h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
+    w3p = jnp.asarray(rng.normal(size=(mid, IF, O)), jnp.float32)
+    b3p = jnp.asarray(rng.normal(size=(IF, O)), jnp.float32)
+    v2 = jnp.asarray(rng.normal(size=(E, P, IF)), jnp.float32)
+    C, Q, F = 64, 7, 7
+    w3x = jnp.asarray(rng.normal(size=(mid, C * F, O)), jnp.float32)
+    b3x = jnp.asarray(rng.normal(size=(C * F, O)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(E, C, Q)), jnp.float32)
+    bas = jnp.asarray(rng.normal(size=(E, P, Q, F)), jnp.float32)
+    flat = jnp.asarray(rng.normal(size=(E, P * F * Q)), jnp.float32)
+
+    def fns(kind):
+        kwp = {'b3': b3p} if has_b3 else {}
+        kwx = {'b3': b3x} if has_b3 else {}
+        pick_bx = lambda: pp._pick_blocks_bx(E, C, O, P, Q, F, mid)  # noqa: E731,E501
+        if kind == 'plain':
+            return (lambda: pp.fused_pairwise_conv(h, w3p, v2, **kwp),
+                    lambda: pp._pick_blocks(E, IF, O, P, mid))
+        if kind == 'bxf':
+            return (lambda: pp.fused_pairwise_conv_bxf(
+                h, w3x, flat, x, (P, Q, F), **kwx), pick_bx)
+        return (lambda: pp.fused_pairwise_conv_bx(h, w3x, bas, x, **kwx),
+                pick_bx)
+
+    def clear_caches():
+        # getattr by name: an old loaded package may predate some entry
+        # points (e.g. bxf landed in 3c1c681) — missing ones are skipped,
+        # not an AttributeError that voids every setting
+        for name in ('fused_pairwise_conv', 'fused_pairwise_conv_bx',
+                     'fused_pairwise_conv_bxf'):
+            f = getattr(pp, name, None)
+            if f is not None and hasattr(f, 'clear_cache'):
+                f.clear_cache()
+
+    for kind, env_blocks in settings:
+        rec = dict(kind=kind, mode='in-process', bias_unfolded=has_b3,
+                   **env_blocks)
+        saved = {k: os.environ.pop(k) for k in list(os.environ)
+                 if k.startswith('SE3_TPU_BLOCK_')}
+        os.environ.update(env_blocks)
+        try:
+            clear_caches()
+            fn, pick = fns(kind)
+            rec['blocks'] = list(pick())
+            t_c = time.time()
+            out = jax.block_until_ready(fn())  # compile
+            rec['compile_s'] = round(time.time() - t_c, 1)
+            t0 = time.time()
+            for _ in range(args.iters):
+                out = fn()
+            jax.block_until_ready(out)
+            rec['ms'] = round((time.time() - t0) / args.iters * 1e3, 3)
+            rec['backend'] = backend
+        except Exception as e:  # noqa: BLE001 - isolate per setting
+            msg = f'{type(e).__name__}: {e}'
+            low = msg.lower()
+            if any(s in low for s in ('unavailable', 'broken pipe',
+                                      'connection refused',
+                                      'remote_compile')):
+                raise  # tunnel death: retryable, do not record as data
+            rec['error'] = msg[:300]
+        finally:
+            for k in env_blocks:
+                os.environ.pop(k, None)
+            os.environ.update(saved)
+        print(json.dumps(rec), flush=True)
+        with open(args.out, 'a') as f:
+            f.write(json.dumps(rec) + '\n')
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument('--out', default=os.path.join(REPO, 'KERNEL_TUNE.jsonl'))
@@ -84,6 +203,24 @@ def main(argv=None):
     ap.add_argument('--block-if', type=int, nargs='+', default=[8, 16, 32])
     ap.add_argument('--block-cb', type=int, nargs='+', default=[8, 16])
     args = ap.parse_args(argv)
+
+    settings = []
+    for kind, sizes_key, sizes in (('plain', 'SE3_TPU_BLOCK_IF',
+                                    args.block_if),
+                                   ('bx', 'SE3_TPU_BLOCK_CB',
+                                    args.block_cb),
+                                   ('bxf', 'SE3_TPU_BLOCK_CB',
+                                    args.block_cb)):
+        settings.append((kind, {}))  # heuristic default: baseline to beat
+        for be in args.block_e:
+            if be == 0:
+                continue
+            for bs in sizes:
+                settings.append((kind, {'SE3_TPU_BLOCK_E': str(be),
+                                        sizes_key: str(bs)}))
+
+    if _backend_initialized_here():
+        return _run_inprocess(args, settings)
 
     child = os.path.join('/tmp', 'kernel_tune_child.py')
     with open(child, 'w') as f:
@@ -115,18 +252,8 @@ def main(argv=None):
             f.write(json.dumps(rec) + '\n')
         return rec
 
-    for kind, sizes_key, sizes in (('plain', 'SE3_TPU_BLOCK_IF',
-                                    args.block_if),
-                                   ('bx', 'SE3_TPU_BLOCK_CB',
-                                    args.block_cb),
-                                   ('bxf', 'SE3_TPU_BLOCK_CB',
-                                    args.block_cb)):
-        run(kind, {})  # heuristic default first: the baseline to beat
-        for be in args.block_e:
-            if be == 0:
-                continue
-            for bs in sizes:
-                run(kind, {'SE3_TPU_BLOCK_E': str(be), sizes_key: str(bs)})
+    for kind, env_blocks in settings:
+        run(kind, env_blocks)
 
 
 if __name__ == '__main__':

@@ -154,6 +154,10 @@ def main():
     if rev:
         os.environ['SE3_TPU_CODE_REV'] = rev
         log(f'code_rev pinned: {rev}')
+    else:
+        # git lookup failed: a stale inherited pin must not win either
+        os.environ.pop('SE3_TPU_CODE_REV', None)
+        log('code_rev unavailable (git lookup failed); env pin cleared')
     import se3_transformer_tpu  # noqa: F401 - eager load at the pinned rev
 
     # persist compiles across session relaunches: the tunnel can die

@@ -15,11 +15,15 @@ import json
 import multiprocessing
 import time
 
-# first recorded nodes*steps/sec/chip on TPU v5e-1 (update as they improve)
-# 2026-08-01 round 3 session 5: flagship dim=64 depth=6 deg=4 k=32 n=1024
-# (remat recipe, MXU one-hot gather); conservative step_ms=3902.72,
-# fast (fuse_basis + radial_bf16) step_ms=3307.78. Each path compares
-# against its own record — they run different programs.
+# first recorded nodes*steps/sec/chip on TPU v5e-1: round-3 session 5,
+# flagship dim=64 depth=6 deg=4 k=32 n=1024 (remat recipe, MXU one-hot
+# gather); conservative step_ms=3902.72, fast step_ms=3307.78. Each path
+# compares against its own record — they run different programs. KEPT as
+# the round-3 anchors so vs_baseline measures round-4 progress:
+# round-4 session measurements on a LOADED host (idle-host numbers run
+# higher) — conservative 295.94 (bias un-folding: the radial-apply
+# contraction dim 129 -> 128, killing the MXU's 2x padding tax),
+# fast 427.62 (+ the unchunked re-cut: edge_chunks=None, no lax.map tax).
 RECORD = 262.38
 FAST_RECORD = 309.57
 

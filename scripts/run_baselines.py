@@ -127,9 +127,32 @@ def main(argv=None):
     backend = jax.default_backend()
     print(f'backend: {backend}')
     counts = node_counts()
+    # merge-on-write: a partial run (e.g. tunnel death after config 1)
+    # must not clobber rows from configs it never reached — round 4 lost
+    # the six-row on-chip table exactly that way. New rows replace
+    # same-config/same-backend rows; everything else is preserved.
+    prior = []
+    if args.out and os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                loaded = json.load(f)
+            # shape-validate: a malformed prior must degrade to "no
+            # prior", not crash the write loop after config 1
+            prior = [r for r in loaded if isinstance(r, dict)
+                     and 'config' in r] if isinstance(loaded, list) else []
+        except Exception:
+            prior = []
     results = []
     names = args.configs or list(RECIPES)
     failed = []
+
+    def merged():
+        # key on (config, backend): a --cpu liveness run must never
+        # replace the on-chip row for the same config
+        done = {(r['config'], r.get('backend')) for r in results}
+        keep = [r for r in prior
+                if (r['config'], r.get('backend')) not in done]
+        return keep + results
     for name in names:
         builder = RECIPES[name]
         module = builder(dim=args.flagship_dim) \
@@ -149,7 +172,7 @@ def main(argv=None):
         results.append(rec)
         if args.out:  # write-as-you-go: survive a later config crashing
             with open(args.out, 'w') as f:
-                json.dump(results, f, indent=1)
+                json.dump(merged(), f, indent=1)
     if args.out and results:
         print(f'wrote {args.out}')
     if failed:

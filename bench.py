@@ -165,13 +165,21 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
         overrides = dict(output_degrees=2, reduce_dim_out=True)
         if chunk_env != '':
             overrides['edge_chunks'] = int(chunk_env) or None
+        # SE3_TPU_BENCH_REMAT overrides the reversible remat policy
+        # (e.g. 'save_conv_outputs' — the backward replay then skips the
+        # dominant radial contraction, ops/trunk.py). Labelled rp= so an
+        # overridden record never masquerades as the recipe default.
+        remat_env = os.environ.get('SE3_TPU_BENCH_REMAT', '')
+        if remat_env:
+            overrides['remat_policy'] = remat_env
         # vector head for the denoise objective: the recipe default
         # output_degrees=1 is scalar-out (return_type coerced to 0)
         module = recipes.RECIPES[recipe_name](dim=dim, **overrides)
         num_degrees = module.num_degrees
         label = f'{recipe_name},dim={dim},depth={module.depth}' + (
             f',b={batch}' if batch != 1 else '') + (
-            f',ec={int(chunk_env)}' if chunk_env != '' else '')
+            f',ec={int(chunk_env)}' if chunk_env != '' else '') + (
+            f',rp={remat_env}' if remat_env else '')
     else:
         # liveness fallback only (wedged/absent TPU): tiny config so the
         # bench still completes and is honestly labelled backend=cpu.

@@ -79,6 +79,11 @@ class SE3TransformerModule(nn.Module):
     num_edge_tokens: Optional[int] = None
     edge_dim: Optional[int] = None
     reversible: bool = False
+    # reversible remat policy: None = recompute everything (O(1)
+    # activations), 'save_conv_outputs' = store the ConvSE3 results so
+    # the backward replay skips the dominant radial contraction
+    # (ops/trunk.py::_resolve_remat_policy)
+    remat_policy: Optional[str] = None
     attend_self: bool = True
     use_null_kv: bool = False
     differentiable_coors: bool = False
@@ -479,6 +484,10 @@ class SE3TransformerModule(nn.Module):
     def _trunk(self, x, fiber_hidden, edge_info, rel_dist, basis,
                global_feats, pos_emb, mask, conv_kwargs):
         if self.use_egnn:
+            # the EGNN trunk has no ConvSE3 tags — a policy here would be
+            # a silent no-op claimed by the config
+            assert self.remat_policy is None, \
+                'remat_policy applies to the conv-attention trunk only'
             return EGnnNetwork(
                 fiber=fiber_hidden, depth=self.depth,
                 edge_dim=conv_kwargs['edge_dim'],
@@ -504,7 +513,8 @@ class SE3TransformerModule(nn.Module):
             tie_key_values=self.tie_key_values,
             one_headed_key_values=self.one_headed_key_values,
             norm_gated_scale=self.norm_gated_scale,
-            reversible=self.reversible, pallas=self.pallas,
+            reversible=self.reversible, remat_policy=self.remat_policy,
+            pallas=self.pallas,
             pallas_attention=self.pallas_attention,
             pallas_attention_interpret=self.pallas_attention_interpret,
             shared_radial_hidden=self.shared_radial_hidden,

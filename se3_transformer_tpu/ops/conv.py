@@ -575,4 +575,17 @@ class ConvSE3(nn.Module):
                                  name='self_interact')(inp)
             outputs = residual_se3(outputs, self_out)
 
+        # Name the conv outputs for policy-based remat (trunk.py
+        # remat_policy='save_conv_outputs'): under
+        # save_only_these_names('conv_out') the reversible trunk's
+        # backward replay fetches these tensors from storage instead of
+        # re-running the radial contraction — whose apply matmul is ~95%
+        # of all flagship FLOPs (utils/flops.py). The Pallas kernels'
+        # custom_vjp residuals are their *inputs* (h, w3, v2/basis/x),
+        # so with the output saved the replay DCEs the kernel forward
+        # entirely and only recomputes the cheap glue (trunk MLP,
+        # gather, norms). Outside jax.checkpoint the names are inert.
+        from jax.ad_checkpoint import checkpoint_name
+        outputs = {k: checkpoint_name(v, 'conv_out')
+                   for k, v in outputs.items()}
         return outputs

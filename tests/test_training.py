@@ -185,3 +185,64 @@ def test_dataset_feeds_model(tmp_path):
                     jnp.asarray(batch['mask']), return_type=0)
         assert out.shape == (2, 16, 8)
         assert np.isfinite(np.asarray(out)).all()
+
+
+def test_remat_policy_save_conv_outputs_matches_full_remat():
+    """remat_policy='save_conv_outputs' (trunk.py) changes only WHAT the
+    reversible backward stores vs recomputes — loss and gradients must
+    match the recompute-everything default bitwise-or-near (same ops,
+    same order, modulo XLA scheduling)."""
+    from se3_transformer_tpu.models.se3_transformer import (
+        SE3TransformerModule,
+    )
+    rng = np.random.RandomState(3)
+    feats = jnp.asarray(rng.normal(size=(1, 12, 8)), jnp.float32)
+    coors = jnp.asarray(rng.normal(size=(1, 12, 3)) * 2, jnp.float32)
+    mask = jnp.ones((1, 12), bool)
+
+    def loss_and_grads(policy):
+        m = SE3TransformerModule(
+            dim=8, depth=2, num_degrees=2, heads=2, dim_head=4,
+            attend_self=True, num_neighbors=4, reversible=True,
+            remat_policy=policy, shared_radial_hidden=True,
+            output_degrees=2, reduce_dim_out=True)
+        params = m.init(jax.random.PRNGKey(0), feats, coors, mask=mask,
+                        return_type=1)['params']
+
+        def loss_fn(p):
+            out = m.apply({'params': p}, feats, coors, mask=mask,
+                          return_type=1)
+            return (out ** 2).sum()
+
+        loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+        return loss, grads
+
+    l0, g0 = loss_and_grads(None)
+    l1, g1 = loss_and_grads('save_conv_outputs')
+    assert np.allclose(l0, l1, rtol=1e-6), (l0, l1)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_remat_policy_unknown_raises():
+    from se3_transformer_tpu.ops.trunk import _resolve_remat_policy
+    import pytest
+    with pytest.raises(ValueError, match='unknown remat_policy'):
+        _resolve_remat_policy('nope')
+
+
+def test_remat_policy_requires_reversible():
+    from se3_transformer_tpu.models.se3_transformer import (
+        SE3TransformerModule,
+    )
+    import pytest
+    m = SE3TransformerModule(dim=8, depth=1, num_degrees=2, heads=2,
+                             dim_head=4, num_neighbors=4,
+                             remat_policy='save_conv_outputs')
+    feats = jnp.zeros((1, 8, 8), jnp.float32)
+    coors = jnp.zeros((1, 8, 3), jnp.float32)
+    with pytest.raises(ValueError, match='requires reversible=True'):
+        m.init(jax.random.PRNGKey(0), feats, coors,
+               mask=jnp.ones((1, 8), bool), return_type=0)

@@ -139,6 +139,7 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
     from se3_transformer_tpu.utils.compilation_cache import (
         enable_compilation_cache,
     )
+    from se3_transformer_tpu.utils.helpers import fetch_sync
 
     enable_compilation_cache()
 
@@ -167,11 +168,14 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
             overrides['edge_chunks'] = int(chunk_env) or None
         # SE3_TPU_BENCH_REMAT overrides the reversible remat policy
         # (e.g. 'save_conv_outputs' — the backward replay then skips the
-        # dominant radial contraction, ops/trunk.py). Labelled rp= so an
-        # overridden record never masquerades as the recipe default.
+        # dominant radial contraction, ops/trunk.py; 'none' forces the
+        # policy OFF, the control arm now that the flagship_fast recipe
+        # defaults it on). Labelled rp= so an overridden record never
+        # masquerades as the recipe default.
         remat_env = os.environ.get('SE3_TPU_BENCH_REMAT', '')
         if remat_env:
-            overrides['remat_policy'] = remat_env
+            overrides['remat_policy'] = (
+                None if remat_env.lower() == 'none' else remat_env)
         # vector head for the denoise objective: the recipe default
         # output_degrees=1 is scalar-out (return_type coerced to 0)
         module = recipes.RECIPES[recipe_name](dim=dim, **overrides)
@@ -251,15 +255,30 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
     except Exception:
         pass
 
-    # warmup
+    # warmup (fetch_sync: an early-returning block here would leak
+    # warmup work into the timed window)
     params, opt_state, loss, _ = exec_fn(params, opt_state, data, key)
-    jax.block_until_ready(loss)
+    fetch_sync(loss)
 
+    # keep dispatch async (block only at the end — same timing semantics
+    # as before) but RETAIN every step's loss: the 19:29Z session record
+    # measured an impossible 411 ms conservative step and the losses
+    # that would have exposed (or exonerated) it were discarded. The
+    # trajectory now travels with the record.
+    losses = []
     t0 = time.time()
     for _ in range(steps):
         key, sub = jax.random.split(key)
         params, opt_state, loss, _ = exec_fn(params, opt_state, data, sub)
-    jax.block_until_ready(loss)
+        losses.append(loss)
+    # close the window by HOST-MATERIALIZING the chain tail, not
+    # block_until_ready: the axon runtime returned from block tens of
+    # seconds early on fresh programs (utils.helpers.fetch_sync), which
+    # produced two impossible records (411/401 ms "steps") before the
+    # loss trajectory exposed it. The loss floats gate every forward;
+    # one small param leaf gates the final optimizer tail.
+    losses = [float(l) for l in losses]
+    fetch_sync(min(jax.tree_util.tree_leaves(params), key=lambda l: l.size))
     dt = time.time() - t0
 
     nodes_steps_per_sec = batch * num_nodes * steps / dt
@@ -343,6 +362,13 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
         'equivariance_l2': eq_err,
         'step_ms': round(dt / steps * 1e3, 2),
     }
+    # loss-trajectory sanity: adam at 1e-4 on this objective decreases
+    # monotonically-ish from the first step; a flat or garbage sequence
+    # means the executable did not run the program the label claims
+    record['loss_first'] = round(losses[0], 2)
+    record['loss_last'] = round(losses[-1], 2)
+    record['loss_decreased'] = bool(losses[-1] < losses[0]) \
+        and all(np.isfinite(losses))
     if eq_scope:
         record['equivariance_scope'] = eq_scope
     if device_kind:
@@ -376,6 +402,11 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
             record['step_tflops_analytic'] = round(fl / 1e12, 2)
             record['mfu_f32_analytic'] = round(fl / t_step / PEAK_F32, 4)
             record['mfu_bf16_analytic'] = round(fl / t_step / PEAK_BF16, 4)
+            if fl / t_step > PEAK_BF16:
+                # sustaining more than bf16 peak is physically impossible
+                # for this program: the executable cannot have run the
+                # labelled computation (19:29Z artifact class)
+                record['implausible_throughput'] = True
         except Exception as e:  # noqa: BLE001 - estimator scope (no EGNN)
             print(f'flop estimate failed ({type(e).__name__}: {e})',
                   file=sys.stderr)

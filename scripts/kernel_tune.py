@@ -79,7 +79,7 @@ out = jax.block_until_ready(fn())  # compile
 t0 = time.time()
 for _ in range(iters):
     out = fn()
-jax.block_until_ready(out)
+np.asarray(out.ravel()[:1])  # one-element host fetch gates completion
 ms = (time.time() - t0) / iters * 1e3
 print(json.dumps(dict(kind=kind, blocks=list(blocks), ms=round(ms, 3),
                       backend=jax.default_backend())))
@@ -174,15 +174,16 @@ def _run_inprocess(args, settings):
             t0 = time.time()
             for _ in range(args.iters):
                 out = fn()
-            jax.block_until_ready(out)
+            np.asarray(out.ravel()[:1])  # one-element fetch gates completion
             rec['ms'] = round((time.time() - t0) / args.iters * 1e3, 3)
             rec['backend'] = backend
         except Exception as e:  # noqa: BLE001 - isolate per setting
+            from se3_transformer_tpu.utils.helpers import is_tunnel_error
             msg = f'{type(e).__name__}: {e}'
-            low = msg.lower()
-            if any(s in low for s in ('unavailable', 'broken pipe',
-                                      'connection refused',
-                                      'remote_compile')):
+            # shared classifier: an aggressive block setting that OOMs
+            # must be recorded for this setting and the sweep continue —
+            # only true tunnel deaths (OOMs carved out) abort the sweep
+            if is_tunnel_error(msg):
                 raise  # tunnel death: retryable, do not record as data
             rec['error'] = msg[:300]
         finally:

@@ -1,0 +1,101 @@
+"""Trace the bench-identical flagship TRAIN step (fast path by default).
+
+The session's stage_profile traces the conservative flagship FORWARD;
+this script traces the full training step of the exact program bench.py
+times — fast/conservative, optional remat policy and edge_chunks — so
+trace_summary.py can attribute the step's wall clock op by op.
+
+    python scripts/profile_flagship.py [--conservative] [--remat POLICY]
+        [--chunks N] [--steps 2] [--out /tmp/flagship_fast_trace]
+
+Single-client tunnel rules apply: run only when no other process holds
+the chip.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--out', default='/tmp/flagship_fast_trace')
+    ap.add_argument('--conservative', action='store_true')
+    ap.add_argument('--remat', default=None,
+                    help="remat_policy override (e.g. save_conv_outputs)")
+    ap.add_argument('--chunks', type=int, default=None,
+                    help='edge_chunks override (0 = unchunked)')
+    ap.add_argument('--steps', type=int, default=2)
+    ap.add_argument('--nodes', type=int, default=1024)
+    ap.add_argument('--cpu', action='store_true')
+    args = ap.parse_args(argv)
+
+    import jax
+    if args.cpu:
+        jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from se3_transformer_tpu.parallel.sharding import make_sharded_train_step
+    from se3_transformer_tpu.training import recipes
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    from se3_transformer_tpu.utils.observability import profile_trace
+
+    enable_compilation_cache()
+
+    name = 'flagship' if args.conservative else 'flagship_fast'
+    overrides = dict(output_degrees=2, reduce_dim_out=True)
+    if args.remat:
+        overrides['remat_policy'] = args.remat
+    if args.chunks is not None:
+        overrides['edge_chunks'] = args.chunks or None
+    module = recipes.RECIPES[name](dim=64, **overrides)
+
+    n = args.nodes
+    rng = np.random.RandomState(0)
+    seqs = jnp.asarray(rng.normal(size=(1, n, 64)), jnp.float32)
+    coords = jnp.asarray(np.cumsum(rng.normal(size=(1, n, 3)), axis=1),
+                         jnp.float32)
+    coords = coords - coords.mean(axis=1, keepdims=True)
+    masks = jnp.ones((1, n), bool)
+
+    def loss_fn(params, data, key):
+        noise = jax.random.normal(key, data['coords'].shape,
+                                  data['coords'].dtype)
+        noised = data['coords'] + noise
+        out = module.apply({'params': params}, data['seqs'], noised,
+                           mask=data['masks'], return_type=1)
+        return (((noised + out) - data['coords']) ** 2).sum(-1).mean(), {}
+
+    init_fn = jax.jit(module.init, static_argnames=('return_type',))
+    params = init_fn(jax.random.PRNGKey(0), seqs, coords, mask=masks,
+                     return_type=1)['params']
+    optimizer = optax.adam(1e-4)
+    opt_state = optimizer.init(params)
+    step = make_sharded_train_step(loss_fn, optimizer)
+    data = dict(seqs=seqs, coords=coords, masks=masks)
+    key = jax.random.PRNGKey(1)
+
+    t0 = time.time()
+    params, opt_state, loss, _ = step(params, opt_state, data, key)
+    jax.block_until_ready(loss)
+    print(f'compile+first step: {time.time() - t0:.1f} s '
+          f'({name}, remat={args.remat}, chunks={args.chunks})')
+
+    with profile_trace(args.out):
+        for _ in range(args.steps):
+            key, sub = jax.random.split(key)
+            params, opt_state, loss, _ = step(params, opt_state, data, sub)
+        jax.block_until_ready(loss)
+    print(f'trace written to {args.out}; summarize with '
+          f'scripts/trace_summary.py --dir {args.out}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -88,13 +88,17 @@ def run_config(name, module, n, steps, rng, batch=1):
     jax.block_until_ready(loss)
     compile_s = time.time() - t_c0
 
+    from se3_transformer_tpu.utils.helpers import fetch_sync
     t0 = time.time()
     for _ in range(steps):
         key, sub = jax.random.split(key)
         params, opt_state, loss = step(params, opt_state, sub)
-    jax.block_until_ready(loss)
-    dt = time.time() - t0
+    # host-materialize inside the window (loss gates the last forward, a
+    # small param leaf gates the optimizer tail): block_until_ready was
+    # observed to return tens of seconds early on this runtime
     loss = float(loss)
+    fetch_sync(min(jax.tree_util.tree_leaves(params), key=lambda l: l.size))
+    dt = time.time() - t0
     assert np.isfinite(loss), f'{name}: non-finite loss'
     return dict(config=name, nodes=n, steps=steps, loss=loss,
                 step_ms=round(dt / steps * 1e3, 2),

@@ -20,11 +20,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def timeit(fn, args, iters):
+    from se3_transformer_tpu.utils.helpers import fetch_sync_tail
     out = jax.block_until_ready(fn(*args))  # compile
     t0 = time.time()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    fetch_sync_tail(out)  # one-element host fetch gates completion
     return (time.time() - t0) / iters * 1e3  # ms
 
 

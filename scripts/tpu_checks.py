@@ -17,6 +17,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+from se3_transformer_tpu.utils.helpers import fetch_sync_tail
 import jax.numpy as jnp
 import numpy as np
 
@@ -114,7 +115,7 @@ def bench_conv(pallas: bool, n=512, k=24, dim=32, degrees=3, iters=10,
     t0 = time.time()
     for _ in range(iters):
         out = fwd(params, args)
-    jax.block_until_ready(out)
+    fetch_sync_tail(out)  # one-element host fetch gates completion
     dt = (time.time() - t0) / iters
 
     # numerics comparison at f32 precision (timing above uses the default
@@ -200,7 +201,7 @@ def bench_attention(variant: str, B=1, h=8, n=1024, J=33, D=56, iters=20):
     t0 = time.time()
     for _ in range(iters):
         out = fn(q, k, v)
-    jax.block_until_ready(out)
+    fetch_sync_tail(out)  # one-element host fetch gates completion
     return (time.time() - t0) / iters, out
 
 

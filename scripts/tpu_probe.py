@@ -138,10 +138,12 @@ def main(argv=None):
                     # "does not fit"; any other error (a transient
                     # infra failure whose message misses tunnel_sigs)
                     # must be re-attempted next cycle
-                    err = (rec.get('error') or '').lower()
+                    from se3_transformer_tpu.utils.helpers import (
+                        is_oom_error,
+                    )
+                    err = rec.get('error') or ''
                     oom = (not rec.get('fits')) and (
-                        'resource_exhausted' in err or 'out of memory'
-                        in err or 'oom' in err)
+                        is_oom_error(err) or 'oom' in err.lower())
                     if real or oom:
                         done[(rec.get('dim'), rec.get('edge_chunks'),
                               rec.get('reversible', True),
@@ -150,11 +152,11 @@ def main(argv=None):
         except OSError:
             pass
 
-    # tunnel-death signatures: such failures must PROPAGATE so
-    # tpu_session's retryable-exit detection fires — recording them as
-    # fits=False would both corrupt the table and end the session loop
-    tunnel_sigs = ('unavailable', 'broken pipe', 'network error',
-                   'connection refused', 'remote_compile')
+    # tunnel-death failures must PROPAGATE so tpu_session's
+    # retryable-exit detection fires — recording them as fits=False
+    # would both corrupt the table and end the session loop. OOMs are
+    # carved out inside is_tunnel_error (helpers: one shared list).
+    from se3_transformer_tpu.utils.helpers import is_tunnel_error
 
     def run_and_record(**pt):
         key = (pt['dim'], pt['edge_chunks'], pt.get('reversible', True),
@@ -175,7 +177,7 @@ def main(argv=None):
             rec['fits'] = True
         except Exception as e:  # noqa: BLE001
             msg = f'{type(e).__name__}: {e}'
-            if any(s in msg.lower() for s in tunnel_sigs):
+            if is_tunnel_error(msg):
                 raise  # retryable infrastructure failure, not a fit result
             rec['fits'] = False
             rec['error'] = msg[:220]

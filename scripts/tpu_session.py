@@ -175,10 +175,12 @@ def main():
         # fail UNAVAILABLE / broken pipe) is RETRYABLE from a fresh
         # process — exit 3 so the session loop relaunches, instead of
         # rc=2 which ends the loop with stages uncollected
-        low = tb.lower()
-        if any(sig in low for sig in ('unavailable', 'broken pipe',
-                                      'network error', 'connection refused',
-                                      'remote_compile')):
+        # shared classifier (helpers): a deterministic HBM OOM is NOT a
+        # tunnel death even when the axon client wraps it in a
+        # remote_compile error — relaunching would just re-pay the
+        # compile and OOM again, forever (the b=4-probe cycle of 19:14Z)
+        from se3_transformer_tpu.utils.helpers import is_tunnel_error
+        if is_tunnel_error(tb):
             tunnel_died[0] = True
 
     def run_stage(title, fn, fatal=True):

@@ -1,0 +1,101 @@
+"""Summarize a jax.profiler trace directory: top ops by device time.
+
+Parses the Chrome-trace JSON (trace.json.gz) that jax.profiler writes
+under <dir>/plugins/profile/<ts>/ — no tensorboard/xprof needed. Events
+on device tracks (TPU/TensorCore pids) are aggregated by op name and
+printed as a table with total ms and share, so "what dominates the
+step" is one command:
+
+    python scripts/trace_summary.py --dir /tmp/flagship_trace [--top 30]
+
+The name aggregation folds XLA's fusion suffixes (fusion.123 -> fusion)
+unless --raw; --match FILTER restricts to names containing FILTER.
+"""
+import argparse
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+
+def find_trace_file(d):
+    pats = [os.path.join(d, 'plugins', 'profile', '*', '*.trace.json.gz'),
+            os.path.join(d, '**', '*.trace.json.gz'),
+            os.path.join(d, '*.trace.json.gz')]
+    hits = []
+    for p in pats:
+        hits += glob.glob(p, recursive=True)
+    if not hits:
+        raise FileNotFoundError(f'no *.trace.json.gz under {d}')
+    return max(hits, key=os.path.getmtime)
+
+
+def load_events(path):
+    with gzip.open(path, 'rt') as f:
+        data = json.load(f)
+    return data.get('traceEvents', [])
+
+
+def device_pids(events):
+    """pids whose process name looks like an accelerator/device track
+    (covers 'TPU', 'Tensorcore', '/device:...'; falls back to every pid
+    that is not explicitly host-side python/runtime)."""
+    names = {}
+    for ev in events:
+        if ev.get('ph') == 'M' and ev.get('name') == 'process_name':
+            names[ev['pid']] = ev.get('args', {}).get('name', '')
+    dev = {pid for pid, n in names.items()
+           if re.search(r'tpu|tensorcore|/device|gpu|accelerator', n,
+                        re.IGNORECASE)}
+    if not dev:
+        dev = {pid for pid, n in names.items()
+               if not re.search(r'python|host|plugin|runtime', n,
+                                re.IGNORECASE)}
+    return dev, names
+
+
+def fold_name(name):
+    # fusion.123 / copy.5 / custom-call.7 -> family; keep pallas kernel
+    # names (custom-call targets) intact when present in args
+    return re.sub(r'\.\d+$', '', name)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--dir', required=True)
+    ap.add_argument('--top', type=int, default=30)
+    ap.add_argument('--raw', action='store_true',
+                    help='no fusion-suffix folding')
+    ap.add_argument('--match', default=None)
+    args = ap.parse_args(argv)
+
+    path = find_trace_file(args.dir)
+    events = load_events(path)
+    dev, names = device_pids(events)
+
+    total = 0.0
+    agg = {}
+    for ev in events:
+        if ev.get('ph') != 'X' or ev.get('pid') not in dev:
+            continue
+        name = ev.get('name', '?')
+        if args.match and args.match not in name:
+            continue
+        dur = float(ev.get('dur', 0.0)) / 1e3  # us -> ms
+        key = name if args.raw else fold_name(name)
+        agg[key] = agg.get(key, 0.0) + dur
+        total += dur
+    rows = sorted(agg.items(), key=lambda kv: -kv[1])[:args.top]
+    print(f'# {path}')
+    print(f'# device tracks: '
+          f'{sorted(names.get(p, str(p)) for p in dev)}')
+    print(f'# total device-track time: {total:.1f} ms')
+    for name, ms in rows:
+        print(f'{ms:10.2f} ms  {100 * ms / total:5.1f}%  {name}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

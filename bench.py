@@ -275,11 +275,15 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
     # block_until_ready: the axon runtime returned from block tens of
     # seconds early on fresh programs (utils.helpers.fetch_sync), which
     # produced two impossible records (411/401 ms "steps") before the
-    # loss trajectory exposed it. The loss floats gate every forward;
-    # one small param leaf gates the final optimizer tail.
-    losses = [float(l) for l in losses]
+    # loss trajectory exposed it. Only the TAIL is fetched inside the
+    # window (final loss gates the last forward, one small param leaf
+    # gates the optimizer tail) — fetching every loss here would add a
+    # tunnel round-trip per step to dt; the earlier losses are floated
+    # after the clock stops.
+    last = float(losses[-1])
     fetch_sync(min(jax.tree_util.tree_leaves(params), key=lambda l: l.size))
     dt = time.time() - t0
+    losses = [float(l) for l in losses[:-1]] + [last]
 
     nodes_steps_per_sec = batch * num_nodes * steps / dt
 

@@ -1,0 +1,67 @@
+"""Shared flagship train-step builder for the diagnostic scripts.
+
+bench.py is the source of truth for the officially-timed program; this
+module mirrors its setup (seeds, denoise objective, adam(1e-4), donated
+make_sharded_train_step) so bench_diag.py and profile_flagship.py
+measure the same program without three hand-copied replicas drifting
+apart. Any change to bench.py's program must land here too — the
+bench_diag loss-sequence cross-check (same seeds => identical losses)
+catches a silent divergence.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_flagship_step(fast=True, remat=None, chunks=None, nodes=1024,
+                        dim=64, batch=1):
+    """Returns (step, params, opt_state, data, key, module): the
+    bench-identical donated train step and its initial state.
+
+    remat: remat_policy override ('none' forces the policy off);
+    chunks: edge_chunks override (0 = unchunked)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from se3_transformer_tpu.parallel.sharding import make_sharded_train_step
+    from se3_transformer_tpu.training import recipes
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    enable_compilation_cache()
+
+    name = 'flagship_fast' if fast else 'flagship'
+    overrides = dict(output_degrees=2, reduce_dim_out=True)
+    if remat:
+        overrides['remat_policy'] = None if remat == 'none' else remat
+    if chunks is not None:
+        overrides['edge_chunks'] = chunks or None
+    module = recipes.RECIPES[name](dim=dim, **overrides)
+
+    rng = np.random.RandomState(0)
+    seqs = jnp.asarray(rng.normal(size=(batch, nodes, dim)), jnp.float32)
+    coords = jnp.asarray(np.cumsum(
+        rng.normal(size=(batch, nodes, 3)), axis=1), jnp.float32)
+    coords = coords - coords.mean(axis=1, keepdims=True)
+    masks = jnp.ones((batch, nodes), bool)
+
+    def loss_fn(params, data, key):
+        noise = jax.random.normal(key, data['coords'].shape,
+                                  data['coords'].dtype)
+        noised = data['coords'] + noise
+        out = module.apply({'params': params}, data['seqs'], noised,
+                           mask=data['masks'], return_type=1)
+        loss = (((noised + out) - data['coords']) ** 2).sum(-1).mean()
+        return loss, dict()
+
+    init_fn = jax.jit(module.init, static_argnames=('return_type',))
+    params = init_fn(jax.random.PRNGKey(0), seqs, coords, mask=masks,
+                     return_type=1)['params']
+    optimizer = optax.adam(1e-4)
+    opt_state = optimizer.init(params)
+    step = make_sharded_train_step(loss_fn, optimizer)
+    data = dict(seqs=seqs, coords=coords, masks=masks)
+    return step, params, opt_state, data, jax.random.PRNGKey(1), module

@@ -30,49 +30,10 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     import jax
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
-
-    from se3_transformer_tpu.parallel.sharding import make_sharded_train_step
-    from se3_transformer_tpu.training import recipes
-    from se3_transformer_tpu.utils.compilation_cache import (
-        enable_compilation_cache,
-    )
-    enable_compilation_cache()
+    from _flagship_common import build_flagship_step
     print('backend:', jax.default_backend(), flush=True)
-
-    num_nodes, batch = 1024, 1
-    name = 'flagship_fast' if args.fast else 'flagship'
-    overrides = dict(output_degrees=2, reduce_dim_out=True)
-    if args.remat:
-        overrides['remat_policy'] = args.remat
-    module = recipes.RECIPES[name](dim=64, **overrides)
-
-    rng = np.random.RandomState(0)
-    seqs = jnp.asarray(rng.normal(size=(batch, num_nodes, 64)), jnp.float32)
-    coords = jnp.asarray(np.cumsum(
-        rng.normal(size=(batch, num_nodes, 3)), axis=1), jnp.float32)
-    coords = coords - coords.mean(axis=1, keepdims=True)
-    masks = jnp.ones((batch, num_nodes), bool)
-
-    def loss_fn(params, data, key):
-        noise = jax.random.normal(key, data['coords'].shape,
-                                  data['coords'].dtype)
-        noised = data['coords'] + noise
-        out = module.apply({'params': params}, data['seqs'], noised,
-                           mask=data['masks'], return_type=1)
-        loss = (((noised + out) - data['coords']) ** 2).sum(-1).mean()
-        return loss, dict()
-
-    init_fn = jax.jit(module.init, static_argnames=('return_type',))
-    params = init_fn(jax.random.PRNGKey(0), seqs, coords, mask=masks,
-                     return_type=1)['params']
-    optimizer = optax.adam(1e-4)
-    opt_state = optimizer.init(params)
-    step = make_sharded_train_step(loss_fn, optimizer)  # donate, as bench
-    data = dict(seqs=seqs, coords=coords, masks=masks)
-    key = jax.random.PRNGKey(1)
+    step, params, opt_state, data, key, _ = build_flagship_step(
+        fast=args.fast, remat=args.remat)
 
     exec_fn = step
     if args.mode == 'aot':

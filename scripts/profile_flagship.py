@@ -35,55 +35,18 @@ def main(argv=None):
     import jax
     if args.cpu:
         jax.config.update('jax_platforms', 'cpu')
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
-
-    from se3_transformer_tpu.parallel.sharding import make_sharded_train_step
-    from se3_transformer_tpu.training import recipes
-    from se3_transformer_tpu.utils.compilation_cache import (
-        enable_compilation_cache,
-    )
+    from _flagship_common import build_flagship_step
+    from se3_transformer_tpu.utils.helpers import fetch_sync
     from se3_transformer_tpu.utils.observability import profile_trace
 
-    enable_compilation_cache()
-
+    step, params, opt_state, data, key, module = build_flagship_step(
+        fast=not args.conservative, remat=args.remat, chunks=args.chunks,
+        nodes=args.nodes)
     name = 'flagship' if args.conservative else 'flagship_fast'
-    overrides = dict(output_degrees=2, reduce_dim_out=True)
-    if args.remat:
-        overrides['remat_policy'] = args.remat
-    if args.chunks is not None:
-        overrides['edge_chunks'] = args.chunks or None
-    module = recipes.RECIPES[name](dim=64, **overrides)
-
-    n = args.nodes
-    rng = np.random.RandomState(0)
-    seqs = jnp.asarray(rng.normal(size=(1, n, 64)), jnp.float32)
-    coords = jnp.asarray(np.cumsum(rng.normal(size=(1, n, 3)), axis=1),
-                         jnp.float32)
-    coords = coords - coords.mean(axis=1, keepdims=True)
-    masks = jnp.ones((1, n), bool)
-
-    def loss_fn(params, data, key):
-        noise = jax.random.normal(key, data['coords'].shape,
-                                  data['coords'].dtype)
-        noised = data['coords'] + noise
-        out = module.apply({'params': params}, data['seqs'], noised,
-                           mask=data['masks'], return_type=1)
-        return (((noised + out) - data['coords']) ** 2).sum(-1).mean(), {}
-
-    init_fn = jax.jit(module.init, static_argnames=('return_type',))
-    params = init_fn(jax.random.PRNGKey(0), seqs, coords, mask=masks,
-                     return_type=1)['params']
-    optimizer = optax.adam(1e-4)
-    opt_state = optimizer.init(params)
-    step = make_sharded_train_step(loss_fn, optimizer)
-    data = dict(seqs=seqs, coords=coords, masks=masks)
-    key = jax.random.PRNGKey(1)
 
     t0 = time.time()
     params, opt_state, loss, _ = step(params, opt_state, data, key)
-    jax.block_until_ready(loss)
+    fetch_sync(loss)  # block_until_ready returns early on this runtime
     print(f'compile+first step: {time.time() - t0:.1f} s '
           f'({name}, remat={args.remat}, chunks={args.chunks})')
 
@@ -91,7 +54,8 @@ def main(argv=None):
         for _ in range(args.steps):
             key, sub = jax.random.split(key)
             params, opt_state, loss, _ = step(params, opt_state, data, sub)
-        jax.block_until_ready(loss)
+        # the trace window must not close before the steps have run
+        fetch_sync(loss)
     print(f'trace written to {args.out}; summarize with '
           f'scripts/trace_summary.py --dir {args.out}')
     return 0

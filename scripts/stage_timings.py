@@ -22,6 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def timeit(fn, args, iters):
     from se3_transformer_tpu.utils.helpers import fetch_sync_tail
     out = jax.block_until_ready(fn(*args))  # compile
+    fetch_sync_tail(out)  # warm the gating fetch (its own tiny program)
     t0 = time.time()
     for _ in range(iters):
         out = fn(*args)

@@ -143,7 +143,8 @@ def main(argv=None):
                     )
                     err = rec.get('error') or ''
                     oom = (not rec.get('fits')) and (
-                        is_oom_error(err) or 'oom' in err.lower())
+                        rec.get('oom') or is_oom_error(err)
+                        or 'oom' in err.lower())
                     if real or oom:
                         done[(rec.get('dim'), rec.get('edge_chunks'),
                               rec.get('reversible', True),
@@ -176,14 +177,35 @@ def main(argv=None):
                                    batch=pt.get('batch', 1)))
             rec['fits'] = True
         except Exception as e:  # noqa: BLE001
+            from se3_transformer_tpu.utils.helpers import is_oom_error
             msg = f'{type(e).__name__}: {e}'
             if is_tunnel_error(msg):
                 raise  # retryable infrastructure failure, not a fit result
             rec['fits'] = False
             rec['error'] = msg[:220]
+            # classify at FULL-message time: the 220-char truncation can
+            # cut the OOM text off (observed: the HTTP-500 wrapper alone
+            # survived), and the resume matcher must not re-pay this
+            # arm's compile every relaunch
+            rec['oom'] = is_oom_error(msg)
         print(json.dumps(rec), flush=True)
         with open(args.out, 'a') as f:
             f.write(json.dumps(rec) + '\n')
+        if rec.get('oom'):
+            # a RUNTIME OOM can leave the device allocator poisoned —
+            # every later allocation in this process then fails
+            # instantly (observed 22:12Z: the whole remaining stage
+            # order burned down in 9 s). Canary-probe the allocator;
+            # if poisoned, relaunch from a fresh process. The arm is
+            # already durably recorded (rec['oom']), so the relaunch
+            # skips it — no retry cycle.
+            try:
+                import jax.numpy as jnp
+                (jnp.zeros((8,), jnp.float32) + 1).block_until_ready()
+            except Exception as ce:  # noqa: BLE001
+                raise RuntimeError(
+                    'RELAUNCH_NEEDED: device allocator poisoned after '
+                    f'recorded OOM ({type(ce).__name__})') from ce
         return rec
 
     # cheapest-first so early tunnel deaths still leave a table; dims

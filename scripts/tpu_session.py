@@ -178,10 +178,28 @@ def main():
         # shared classifier (helpers): a deterministic HBM OOM is NOT a
         # tunnel death even when the axon client wraps it in a
         # remote_compile error — relaunching would just re-pay the
-        # compile and OOM again, forever (the b=4-probe cycle of 19:14Z)
-        from se3_transformer_tpu.utils.helpers import is_tunnel_error
+        # compile and OOM again, forever (the b=4-probe cycle of 19:14Z).
+        # RELAUNCH_NEEDED is the explicit poisoned-allocator signal
+        # (tpu_probe's post-OOM canary): the failed work is already
+        # durably recorded, only a fresh process can allocate again.
+        from se3_transformer_tpu.utils.helpers import (
+            is_oom_error, is_tunnel_error,
+        )
+        if 'relaunch_needed' in tb.lower():
+            tunnel_died[0] = True
+            return
         if is_tunnel_error(tb):
             tunnel_died[0] = True
+            return
+        if is_oom_error(tb):
+            # an OOM that poisoned the allocator dooms every later
+            # stage in this process — canary-probe and relaunch if so
+            try:
+                import jax.numpy as jnp
+                (jnp.zeros((8,), jnp.float32) + 1).block_until_ready()
+            except Exception:  # noqa: BLE001
+                log('allocator poisoned after OOM; relaunching')
+                tunnel_died[0] = True
 
     def run_stage(title, fn, fatal=True):
         """One crash-isolated stage: log the banner, run fn, classify any

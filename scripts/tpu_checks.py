@@ -179,10 +179,11 @@ def bench_attention(variant: str, B=1, h=8, n=1024, J=33, D=56, iters=20):
     """Attention path comparison at a flagship per-degree shape
     (D = dim_head*(2*deg+1) with dim_head=8 -> 8/24/40/56; J = k+1 kv
     slots) — the model dispatches one kernel per degree. Variants:
-    'xla' einsum path, 'fused' D-on-lanes kernel, 'jt' J-on-lanes
-    layout experiment (VERDICT r3 next #6)."""
+    'xla' einsum path, 'fused' D-on-lanes kernel (the J-on-lanes
+    experiment was retired round 4 — decision table in
+    kernels/pallas_attention.py)."""
     from se3_transformer_tpu.kernels.pallas_attention import (
-        attention_reference, fused_attention, fused_attention_jt,
+        attention_reference, fused_attention,
     )
     rng = np.random.RandomState(0)
     q = jnp.asarray(rng.normal(size=(B * h, n, D)), jnp.float32)
@@ -195,7 +196,6 @@ def bench_attention(variant: str, B=1, h=8, n=1024, J=33, D=56, iters=20):
     impl = dict(
         xla=lambda q, k, v: attention_reference(q, k, v, mask, scale),
         fused=lambda q, k, v: fused_attention(q, k, v, mask, h, scale),
-        jt=lambda q, k, v: fused_attention_jt(q, k, v, mask, h, scale),
     )[variant]
     fn = jax.jit(impl)
     out = jax.block_until_ready(fn(q, k, v))
@@ -256,21 +256,18 @@ def main():
           f'({t_xla/t_rb:.2f}x vs xla), rel diff={diff:.2e} '
           f'[{"PASS" if diff < 3e-2 else "FAIL"}]')
 
-    # attention layout decision table (VERDICT r3 next #6): every
-    # flagship per-degree shape, all three paths. The model runs one
-    # attention per degree, so the layout verdict needs the small-D
-    # shapes where D-on-lanes wastes 16x lane width — not just D=56.
+    # attention numerics + wall-clock at every flagship per-degree
+    # shape. Layout DECIDED round 4 (retirement table in
+    # kernels/pallas_attention.py): XLA is the attention path; the
+    # D-on-lanes kernel stays the validated opt-in.
     for D in (8, 24, 40, 56):
         t_ax, out_ax = bench_attention('xla', D=D)
         t_af, out_af = bench_attention('fused', D=D)
-        t_jt, out_jt = bench_attention('jt', D=D)
         adiff = float(jnp.abs(out_ax - out_af).max())
-        jdiff = float(jnp.abs(out_ax - out_jt).max())
-        ok = adiff < 1e-3 and jdiff < 1e-3
+        ok = adiff < 1e-3
         print(f'attention fwd D={D}: xla {t_ax*1e3:.2f} ms, '
               f'fused(D-lanes) {t_af*1e3:.2f} ms ({t_ax/t_af:.2f}x), '
-              f'jt(J-lanes) {t_jt*1e3:.2f} ms ({t_ax/t_jt:.2f}x), '
-              f'max|diff| fused={adiff:.2e} jt={jdiff:.2e} '
+              f'max|diff| fused={adiff:.2e} '
               f'[{"PASS" if ok else "FAIL"}]')
 
 

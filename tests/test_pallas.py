@@ -243,30 +243,6 @@ def test_fused_attention_matches_reference():
         assert np.abs(np.asarray(ref) - np.asarray(out)).max() < 1e-5
 
 
-def test_fused_attention_jt_matches_reference():
-    """J-on-lanes layout experiment (forward-only): same numerics as the
-    XLA reference across multi-query/mask variants."""
-    from se3_transformer_tpu.kernels.pallas_attention import (
-        attention_reference, fused_attention_jt,
-    )
-    rng = np.random.RandomState(1)
-    for B, h, kv_h, n, J, D in ((2, 4, 4, 40, 9, 24), (1, 4, 1, 16, 5, 8),
-                                (1, 4, 2, 33, 12, 16), (1, 1, 1, 8, 3, 40)):
-        q = jnp.asarray(rng.normal(size=(B * h, n, D)), jnp.float32)
-        k = jnp.asarray(rng.normal(size=(B * kv_h, n, J, D)), jnp.float32)
-        v = jnp.asarray(rng.normal(size=(B * kv_h, n, J, D)), jnp.float32)
-        mask = jnp.asarray(rng.rand(B, n, J) > 0.3)
-        mask = mask.at[:, :, 0].set(True)
-        scale = D ** -0.5
-        ref = attention_reference(q, k, v, mask, scale)
-        out = fused_attention_jt(q, k, v, mask, h, scale, True)
-        assert np.abs(np.asarray(ref) - np.asarray(out)).max() < 1e-5, \
-            (B, h, kv_h, n, J, D)
-        ref = attention_reference(q, k, v, None, scale)
-        out = fused_attention_jt(q, k, v, None, h, scale, True)
-        assert np.abs(np.asarray(ref) - np.asarray(out)).max() < 1e-5
-
-
 def test_fused_attention_gradients():
     from se3_transformer_tpu.kernels.pallas_attention import (
         attention_reference, fused_attention,

@@ -336,21 +336,35 @@ def main():
         log('profile: /tmp/flagship_trace written')
 
     stages = [
-        ('kernel_smoke (Mosaic lowering + numerics)', stage_kernel_smoke,
-         True),
-        ('flagship bench', make_bench_stage(fast=False), True),
-        ('flagship bench (fast: shared radial + fuse_basis + bf16)',
+        ('smoke', 'kernel_smoke (Mosaic lowering + numerics)',
+         stage_kernel_smoke, True),
+        ('bench', 'flagship bench', make_bench_stage(fast=False), True),
+        ('bench_fast',
+         'flagship bench (fast: shared radial + fuse_basis + bf16)',
          make_bench_stage(fast=True), True),
-        ('baseline configs', stage_baselines, True),
-        ('knob/width/batch probe (edge_chunks x dim x batch)', stage_probe,
-         True),
-        ('batched flagship record (best batch from probe)',
+        ('baselines', 'baseline configs', stage_baselines, True),
+        ('probe', 'knob/width/batch probe (edge_chunks x dim x batch)',
+         stage_probe, True),
+        ('batched', 'batched flagship record (best batch from probe)',
          stage_batched_record, True),
-        ('kernel block-size tuning sweep', stage_kernel_tune, True),
-        ('tpu_checks', stage_tpu_checks, True),
-        ('stage timings (flagship bench config)', stage_stage_timings, True),
-        ('flagship profile', stage_profile, False),
+        ('tune', 'kernel block-size tuning sweep', stage_kernel_tune, True),
+        ('checks', 'tpu_checks', stage_tpu_checks, True),
+        ('timings', 'stage timings (flagship bench config)',
+         stage_stage_timings, True),
+        ('profile', 'flagship profile', stage_profile, False),
     ]
+    # SE3_TPU_SESSION_STAGES=smoke,bench,bench_fast,baselines runs a
+    # focused session (e.g. an A/B after a perf commit) without redoing
+    # the already-banked probe/tune/checks sweeps
+    only = os.environ.get('SE3_TPU_SESSION_STAGES')
+    if only:
+        keep = {s.strip() for s in only.split(',') if s.strip()}
+        unknown = keep - {key for key, *_ in stages}
+        if unknown:
+            log(f'WARNING: unknown stage keys ignored: {sorted(unknown)}')
+        stages = [s for s in stages if s[0] in keep]
+        log(f'stage filter: {[key for key, *_ in stages]}')
+    stages = [(title, fn, fatal) for _key, title, fn, fatal in stages]
     for title, fn, fatal in stages:
         if not run_stage(title, fn, fatal=fatal):
             return 3

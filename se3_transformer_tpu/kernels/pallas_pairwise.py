@@ -114,7 +114,7 @@ def _validate_override(block_e, second, second_name, full_second,
 
 
 def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
-                 vmem_budget: int = 13 * 2 ** 20,
+                 vmem_budget: int = 6 * 2 ** 20,
                  max_unroll: int = 256, bwd: bool = False):
     """Choose (block_e, block_if) so the working set fits in VMEM (with
     headroom for double buffering) and the in-kernel unrolled loop count
@@ -124,19 +124,22 @@ def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
     array or be divisible by its tile quantum — so block_if is the full IF
     (n_if == 1) or a multiple of 8, and block_e a multiple of 128.
 
-    Preference order and budget are MEASURED, not modeled (on-chip sweep,
-    KERNEL_TUNE.jsonl, flagship shape class E=32768/IF=1024/O=64/P=7/
-    mid=128 on a v5e): collapsing block_if to 8 costs 18x (277.4 ms vs
-    15.1 ms at block_if=32) because the grid degenerates into tiny
-    DMA-bound w3/R tiles re-streaming the h block IF/8 times, while
-    block_e at a fixed block_if moves the time only a few percent (256:
-    15.11, 512: 15.83). So the picker holds block_if at the unroll
-    target and steps block_e DOWN first, shrinking block_if only when
-    even block_e=128 cannot fit. The old 6 MiB budget turned the model's
-    conservatism into exactly that cliff (the round-3 default picked
-    (512, 8) for the conservative flagship's chunked shape); 13 MiB is
-    calibrated against the same sweep — settings the model prices at
-    12.3 MiB compiled and ran on the 16 MiB scoped-VMEM v5e."""
+    A MEASURED WARNING about re-tuning this from standalone sweeps: the
+    round-4 KERNEL_TUNE sweep timed the STANDALONE plain kernel at the
+    unchunked flagship shape (E=32768/IF=1024/O=7*... on a v5e) and
+    ranked (256, 32) 18x faster than this picker's (512, 8) — but
+    flipping the picker to prefer block_if (commit d0cd10d) made the
+    REAL conservative flagship — the same contraction at E=4096 per
+    chunk under lax.map+remat — 2.7x SLOWER end-to-end (294.97 ->
+    107.51 nodes*steps/s, BENCH_SESSION.jsonl 00:47Z vs 01:39Z, same
+    chip, kernel_smoke green both times). The standalone-vs-production
+    rankings are OPPOSITE: inside the chunked/remat program the large
+    w3/R tiles of a wide block_if evict the lax.map body's working set
+    and the e-grid shortens 8x, while standalone the tiny block_if=8
+    tiles are DMA-bound. The picker therefore keeps the
+    production-validated preference (block_e first); use the
+    SE3_TPU_BLOCK_E/IF overrides to experiment, and only re-rank from
+    END-TO-END bench numbers, never from standalone kernel timings."""
     def _vmem(be, bif):
         # bif*O*128: the [S, 1] bias column tile-pads its lane dim to 128
         return 4 * (mid * be + bif * O * mid + bif * O * 128
@@ -149,15 +152,13 @@ def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
                                      _vmem, vmem_budget):
             return ov[0], min(IF, ov[1])
     e_cap = _round_up(E, 128)
-    block_if = min(IF, max(1, max_unroll // max(P, 1)))
-    if block_if < IF:
-        block_if = max(8, block_if // 8 * 8)
-    while True:
-        # block_e order (256, 512, 128) is the sweep's measured ranking
-        # at equal block_if; see docstring
-        for block_e in (256, 512, 128):
-            if block_e > e_cap:
-                continue
+    for block_e in (512, 256, 128):
+        if block_e > e_cap:
+            continue
+        block_if = min(IF, max(1, max_unroll // max(P, 1)))
+        if block_if < IF:
+            block_if = max(8, block_if // 8 * 8)
+        while True:
             ht = mid * block_e
             w3 = block_if * O * mid
             rt = block_if * O * block_e
@@ -172,9 +173,9 @@ def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
                 total += 4 * (block_e * mid + out + v2 + w3 + b3)
             if total <= vmem_budget:
                 return block_e, block_if
-        if block_if <= 8:
-            break
-        block_if = max(8, block_if // 2 // 8 * 8)
+            if block_if <= 8:
+                break
+            block_if = max(8, block_if // 2 // 8 * 8)
     return 128, min(IF, 8)
 
 
@@ -469,21 +470,19 @@ def _fwd_bx_kernel(ht_ref, w3t_ref, b3t_ref, bt_ref, xt_ref, o_ref, *,
 
 
 def _pick_blocks_bx(E: int, C: int, O: int, P: int, Q: int, F: int,
-                    mid: int, vmem_budget: int = 13 * 2 ** 20,
+                    mid: int, vmem_budget: int = 6 * 2 ** 20,
                     max_unroll: int = 512):
     """(block_e, cb) for the basis-fused kernel. cb is the c-chunk: a
     multiple of 8 (so the xt row-block cb*Q and w3t row-block cb*F*O are
     tile-aligned for any odd Q/F) or the full (padded) C.
 
-    Unlike the plain kernel, the on-chip sweep (KERNEL_TUNE.jsonl,
-    flagship shape class) ranks LARGER block_e better at a fixed cb
-    (bx: 512→7.93 ms, 256→8.19, 128→8.51; bxf: 7.72/7.91/7.90), so the
-    descending block_e order stands. The budget is calibrated the same
-    way as _pick_blocks': the old 6 MiB forced (128, 8) at the flagship
-    shape although (256, 8) — model-priced 12.0 MiB — measured 4%
-    faster and (512, 8) at a model-priced 20.3 MiB still compiled and
-    ran; 13 MiB admits the measured-safe middle without pushing the
-    model past the chip's 16 MiB scoped limit."""
+    The round-4 KERNEL_TUNE standalone sweep at the flagship bxf shape
+    measured the default (128, 8) within 2% of the best override
+    (7.896 vs 7.723 ms at (512, 8)) — and the plain picker's cautionary
+    tale applies (see _pick_blocks: a standalone-sweep-derived
+    "improvement" cost the production conservative path 2.7x), so the
+    budget and ordering stay as production-validated; the
+    SE3_TPU_BLOCK_E/CB overrides are the experimentation path."""
     def _vmem(be, cb):
         return 4 * (mid * be + cb * F * O * mid + cb * F * O * 128
                     + 2 * cb * F * O * be

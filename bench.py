@@ -379,6 +379,12 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
         # prove the record ran on real TPU silicon even when the plugin
         # platform is not named 'tpu' (e.g. axon)
         record['device_kind'] = device_kind
+    if os.environ.get('SE3_TPU_CODE_REV'):
+        # sessions pin the package-tree fingerprint at chip acquisition;
+        # carrying it in the record ties every number to the code that
+        # produced it (the 01:39Z picker-regression record was only
+        # identifiable by timestamp — BENCH_SESSION.jsonl, round 4)
+        record['code_rev'] = os.environ['SE3_TPU_CODE_REV']
     if fallback_reason:
         record['fallback_reason'] = fallback_reason
     if fast_fallback:

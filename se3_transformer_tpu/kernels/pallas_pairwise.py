@@ -511,19 +511,24 @@ def _pick_blocks_bx(E: int, C: int, O: int, P: int, Q: int, F: int,
             if cb <= 8:
                 break
             cb = max(8, cb // 2 // 8 * 8)
-    # even the smallest block exceeds the budget: warn with the offending
-    # dims instead of letting Mosaic surface an opaque VMEM overflow at
-    # compile time (ADVICE r2 #2)
+    # even the smallest block exceeds the model budget: warn with the
+    # offending dims instead of letting Mosaic surface an opaque VMEM
+    # overflow at compile time (ADVICE r2 #2). The estimate mirrors the
+    # loop's accounting at (128, 8) — it previously omitted the b3 term
+    # and so reported "5.7 MiB exceeds 6 MiB". Note the model is
+    # CONSERVATIVE: the flagship bxf shape (P=7, Q=7, F=7, O=64,
+    # mid=128) lands here yet the (128, 8) fallback lowers and runs at
+    # record throughput on the v5e (round-4 kernel_smoke + bench), so
+    # this is a heads-up for genuinely larger shapes, not a hard stop.
     import warnings
-    bt = P * F * Q * 128
-    total = 4 * (mid * 128 + 8 * F * O * mid + 2 * 8 * F * O * 128
-                 + bt + 8 * Q * 128 + P * O * 128)
+    total = _vmem(128, 8)
     warnings.warn(
-        f'fused bx kernel working set ~{total / 2**20:.1f} MiB exceeds '
-        f'the {vmem_budget / 2**20:.0f} MiB VMEM budget even at the '
+        f'fused bx kernel working-set model ~{total / 2**20:.1f} MiB '
+        f'exceeds the {vmem_budget / 2**20:.0f} MiB budget even at the '
         f'smallest block (P={P}, Q={Q}, F={F}, O={O}, mid={mid}); '
-        f'expect a Mosaic VMEM error — use the unfused path for this '
-        f'config', stacklevel=3)
+        f'using (128, 8) — the model is conservative (the flagship '
+        f'shape runs fine here), but a Mosaic VMEM error at much '
+        f'larger shapes means: use the unfused path', stacklevel=3)
     return 128, 8
 
 

@@ -114,11 +114,23 @@ def _validate_override(block_e, second, second_name, full_second,
 
 
 def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
-                 vmem_budget: int = 6 * 2 ** 20,
+                 vmem_budget: Optional[int] = None,
                  max_unroll: int = 256, bwd: bool = False):
     """Choose (block_e, block_if) so the working set fits in VMEM (with
     headroom for double buffering) and the in-kernel unrolled loop count
     P*block_if stays bounded (Mosaic compile time).
+
+    Budget: 7 MiB forward / 6 MiB backward. The forward bump is an
+    END-TO-END measured adoption (the only kind this picker accepts —
+    see the warning below): it moves the flagship plain pick from
+    (512, 8) to (512, 16), which benched 336.21 vs 296.26
+    nodes·steps/s (+13.5%) on the conservative flagship, direction
+    confirmed across alternating A/B pairs under tunnel-latency noise
+    (04:0xZ pair: 300.77 vs 131.01; BENCH_SESSION.jsonl + round-4
+    STATUS). block_if is non-monotonic end-to-end: 8 → 296, 16 → 336,
+    32 → 107 — the budget admits exactly the measured-best middle. The
+    backward keeps 6 MiB: its ~2x working set was never measured past
+    it, and the A/B's backward ran the unchanged heuristic.
 
     Mosaic block-shape rule: every blocked dim must either cover the full
     array or be divisible by its tile quantum — so block_if is the full IF
@@ -140,6 +152,9 @@ def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
     production-validated preference (block_e first); use the
     SE3_TPU_BLOCK_E/IF overrides to experiment, and only re-rank from
     END-TO-END bench numbers, never from standalone kernel timings."""
+    if vmem_budget is None:
+        vmem_budget = (6 if bwd else 7) * 2 ** 20  # see docstring
+
     def _vmem(be, bif):
         # bif*O*128: the [S, 1] bias column tile-pads its lane dim to 128
         return 4 * (mid * be + bif * O * mid + bif * O * 128

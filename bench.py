@@ -13,19 +13,21 @@ used by the device probe only sees function definitions.
 """
 import json
 import multiprocessing
+import os
+import sys
 import time
 
-# first recorded nodes*steps/sec/chip on TPU v5e-1: round-3 session 5,
-# flagship dim=64 depth=6 deg=4 k=32 n=1024 (remat recipe, MXU one-hot
-# gather); conservative step_ms=3902.72, fast step_ms=3307.78. Each path
-# compares against its own record — they run different programs. KEPT as
-# the round-3 anchors so vs_baseline measures round-4 progress:
-# round-4 session measurements on a LOADED host (idle-host numbers run
-# higher) — conservative 295.94 (bias un-folding: the radial-apply
-# contraction dim 129 -> 128, killing the MXU's 2x padding tax),
-# fast 427.62 (+ the unchunked re-cut: edge_chunks=None, no lax.map tax).
-RECORD = 262.38
-FAST_RECORD = 309.57
+# nodes*steps/sec/chip anchors on TPU v5e-1, rolled forward each round so
+# vs_baseline measures THIS round's progress against the last round's
+# banked session records (each path compares against its own record —
+# they run different programs). Round-4 close session (02:40-03:02Z,
+# code_rev 8a81188, BENCH_SESSION.jsonl): conservative 296.26
+# (step_ms 3456.44, eq 9.18e-07), fast 536.69 (step_ms 1907.99,
+# eq 1.07e-06) — bias un-folding + unchunked re-cut +
+# remat_policy='save_conv_outputs' + (512,16) forward blocks.
+# Round-3 anchors were 262.38 / 309.57.
+RECORD = 296.26
+FAST_RECORD = 536.69
 
 
 def _probe_device(q):
@@ -36,18 +38,8 @@ def _probe_device(q):
         q.put(f'error:{type(e).__name__}')
 
 
-def _device_backend_or_cpu(timeout_s: int = 120):
-    """Probe the accelerator backend in a subprocess (the axon TPU tunnel
-    is single-client and can wedge at backend init if a previous holder
-    died), falling back to CPU with an honest metric label.
-
-    Returns (backend, fallback_reason). Any backend other than 'cpu' is
-    accepted as the chip — the driver environment registers the TPU
-    behind a plugin platform that may NOT be named 'tpu' (r03 tail shows
-    "Platform 'axon'"), and a name whitelist here silently forfeited the
-    chip three rounds in a row (VERDICT r3 missing #1). fallback_reason
-    distinguishes probe timeout / import error / genuinely-cpu so a CPU
-    record is diagnosable from the JSON alone (VERDICT r3 weak #2)."""
+def _probe_once(timeout_s: int):
+    """One subprocess probe attempt. Returns (backend, fallback_reason)."""
     ctx = multiprocessing.get_context('spawn')
     q = ctx.Queue()
     p = ctx.Process(target=_probe_device, args=(q,))
@@ -73,6 +65,77 @@ def _device_backend_or_cpu(timeout_s: int = 120):
     return backend, None
 
 
+def _device_backend_or_cpu(timeouts=(120, 240, 600), sleep_s: int = 30):
+    """Probe the accelerator backend in a subprocess (the axon TPU tunnel
+    is single-client and can wedge at backend init if a previous holder
+    died), falling back to CPU with an honest metric label.
+
+    Retries with escalating timeouts (VERDICT r4 next #1): the observed
+    round-4 failure was a single 120 s probe losing to a cold tunnel —
+    round-4's successful session acquired the chip in 8 s once granted,
+    but a tunnel mid-recovery (or draining another client's lease) takes
+    minutes. Before the first attempt, .tpu_stop is touched so any
+    WAITING scripts/tpu_session_loop.sh stands down (a blocked waiter
+    holds no claim but a freshly-granted lease would starve this probe;
+    the loop's watchdog exits waiters within ~35 s of the touch). A
+    claim-HOLDING session finishes its stages and releases on its own —
+    the escalating window (~17 min total) is sized to outlive a focused
+    session's remaining stages.
+
+    Returns (backend, fallback_reason). Any backend other than 'cpu' is
+    accepted as the chip — the driver environment registers the TPU
+    behind a plugin platform that may NOT be named 'tpu' (r03 tail shows
+    "Platform 'axon'"), and a name whitelist here silently forfeited the
+    chip three rounds in a row (VERDICT r3 missing #1). fallback_reason
+    distinguishes probe timeout / import error / genuinely-cpu so a CPU
+    record is diagnosable from the JSON alone (VERDICT r3 weak #2)."""
+    # ask any session loop to stand down for the whole capture window; the
+    # loop deletes the file at its next launch so this cannot disable a
+    # future round's loop (tpu_session_loop.sh header). A KEEPALIVE thread
+    # re-touches every 15 s: a loop launched at any point mid-window
+    # erases the file at startup (rm -f) and its fresh lease would starve
+    # the remaining attempts — per-attempt touches still left the longest
+    # (600 s) attempt uncovered. SE3_TPU_STOP_FILE matches tpu_session's
+    # test-scratch override; SE3_TPU_BENCH_NO_STOP=1 is for in-round
+    # testing, where touching the real stop file would kill the builder's
+    # own waiting loop.
+    import threading
+    stop_path = os.environ.get('SE3_TPU_STOP_FILE') or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), '.tpu_stop')
+    probing_done = threading.Event()
+
+    def keep_stood_down():
+        while not probing_done.is_set():
+            try:
+                with open(stop_path, 'w'):
+                    pass
+            except OSError:
+                pass
+            probing_done.wait(15)
+
+    if os.environ.get('SE3_TPU_BENCH_NO_STOP') != '1':
+        threading.Thread(target=keep_stood_down, daemon=True).start()
+    try:
+        reason = 'probe_not_attempted'
+        for i, t in enumerate(timeouts):
+            backend, reason = _probe_once(t)
+            if backend != 'cpu':
+                return backend, None
+            if reason == 'no_accelerator_registered' or \
+                    'ModuleNotFoundError' in reason or \
+                    'ImportError' in reason:
+                # the plugin answered and said cpu, or jax itself is
+                # absent — deterministic, retrying won't grow a TPU
+                return 'cpu', reason
+            if i + 1 < len(timeouts):
+                print(f'device probe attempt {i + 1}/{len(timeouts)} failed '
+                      f'({reason}); retrying in {sleep_s}s', file=sys.stderr)
+                time.sleep(sleep_s)
+        return 'cpu', reason + f'_after_{len(timeouts)}_attempts'
+    finally:
+        probing_done.set()
+
+
 # what a bare `python bench.py` runs: False = conservative path,
 # True = perf knobs, 'auto' = try fast, fall back to the conservative
 # path if the fast path RAISES (a wedged tunnel hangs either path — the
@@ -95,9 +158,6 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
     failure (record flagged fast_fallback). Default: the
     SE3_TPU_BENCH_FAST env var ('1'/'true'/'auto'/...), else
     DEFAULT_MODE."""
-    import os
-    import sys
-
     import jax
 
     # any accelerator name counts as the chip (axon/tpu/...); only 'cpu'

@@ -246,7 +246,7 @@ def main():
         else:
             log('kernel_smoke: all pass')
 
-    def make_bench_stage(fast, batch=None, edge_chunks=None):
+    def make_bench_stage(fast, batch=None, edge_chunks=None, cb16=False):
         def stage():
             import bench
             if batch is not None:
@@ -260,15 +260,22 @@ def main():
                 # session's fast record — don't re-compile it over the
                 # tunnel
                 os.environ['SE3_TPU_BENCH_EQ'] = '0'
+            if cb16:
+                # conv_bf16 A/B arm (VERDICT r4 next #2): same recipe,
+                # bf16-STORED equivariant operands, labelled cb16
+                os.environ['SE3_TPU_BENCH_CB16'] = '1'
             try:
                 rec = bench.main('tpu', fast=fast)
-                log(f'bench fast={fast} batch={batch or 1}: {rec}')
+                log(f'bench fast={fast} batch={batch or 1} '
+                    f'cb16={cb16}: {rec}')
                 save_bench(rec)
             finally:
                 if batch is not None:
                     os.environ.pop('SE3_TPU_BENCH_BATCH', None)
                     os.environ.pop('SE3_TPU_BENCH_CHUNKS', None)
                     os.environ.pop('SE3_TPU_BENCH_EQ', None)
+                if cb16:
+                    os.environ.pop('SE3_TPU_BENCH_CB16', None)
         return stage
 
     def stage_baselines():
@@ -342,6 +349,14 @@ def main():
         ('bench_fast',
          'flagship bench (fast: shared radial + fuse_basis + bf16)',
          make_bench_stage(fast=True), True),
+        ('bench_cb16',
+         'flagship bench (fast + conv_bf16: bf16-stored equivariant '
+         'operands — the round-5 A/B arm)',
+         make_bench_stage(fast=True, cb16=True), True),
+        ('bench_cb16_cons',
+         'flagship bench (conservative + conv_bf16: the plain kernel '
+         'streams the biggest V2 tensor, so the bandwidth win peaks here)',
+         make_bench_stage(fast=False, cb16=True), True),
         ('baselines', 'baseline configs', stage_baselines, True),
         ('probe', 'knob/width/batch probe (edge_chunks x dim x batch)',
          stage_probe, True),
@@ -363,6 +378,12 @@ def main():
         if unknown:
             log(f'WARNING: unknown stage keys ignored: {sorted(unknown)}')
         stages = [s for s in stages if s[0] in keep]
+        if keep and not stages:
+            # every requested key was a typo: running zero stages and
+            # exiting 0 would report success for a session that did
+            # nothing (ADVICE r4 #2)
+            log('ERROR: stage filter matched no stages — aborting')
+            return 2
         log(f'stage filter: {[key for key, *_ in stages]}')
     stages = [(title, fn, fatal) for _key, title, fn, fatal in stages]
     for title, fn, fatal in stages:

@@ -47,6 +47,7 @@ as per-(i) VMEM blocks in both.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -209,6 +210,10 @@ def _fwd_kernel(ht_ref, w3t_ref, b3t_ref, v2t_ref, o_ref, *, P, O, bif,
         acc = None
         for i in range(bif):
             vrow = v2t_ref[p, i:i + 1, :]            # [1, E_b]
+            if vrow.dtype != jnp.float32:
+                # conv_bf16: V2 is STORED bf16 (half the dominant HBM/VMEM
+                # stream) but the apply math stays f32-on-quantized-values
+                vrow = vrow.astype(jnp.float32)
             term = vrow * rt[i * O:(i + 1) * O, :]   # [O, E_b]
             acc = term if acc is None else acc + term
         sl = slice(p * O, (p + 1) * O)
@@ -257,6 +262,11 @@ def _fused_pairwise_conv_impl(h, w3, b3, v2, interpret, precision):
         if interpret:  # CPU interpret can't dispatch BF16xBF16=F32 dots;
             # the upcast is exact and accumulation is f32 either way
             h, w3 = h.astype(jnp.float32), w3.astype(jnp.float32)
+    if v2.dtype == jnp.bfloat16 and interpret:
+        # conv_bf16 under interpret: the kernel body upcasts bf16 rows to
+        # f32 right after the (Mosaic-only) VMEM load, so pre-upcasting
+        # here is bit-identical — quantize-then-f32 either way
+        v2 = v2.astype(jnp.float32)
 
     block_e, block_if = _pick_blocks(E, IF, O, P, mid)
     Ep, IFp = _round_up(E, block_e), _round_up(IF, block_if)
@@ -467,10 +477,17 @@ def _fwd_bx_kernel(ht_ref, w3t_ref, b3t_ref, bt_ref, xt_ref, o_ref, *,
         for il in range(cb * F):
             c_l, f_l = divmod(il, F)
             b_sl = (p * F + f_l) * Q
-            # V2 row for (p, i=(c, f)): one [Q, E] product + reduction
-            v2row = jnp.sum(
-                bt_ref[b_sl:b_sl + Q, :] * xt_ref[c_l * Q:(c_l + 1) * Q, :],
-                axis=0, keepdims=True)               # [1, E_b]
+            # V2 row for (p, i=(c, f)): one [Q, E] product + reduction.
+            # conv_bf16 stores B/x bf16 in HBM/VMEM (halving the biggest
+            # streams); rows upcast at use so the math stays f32
+            brows = bt_ref[b_sl:b_sl + Q, :]
+            xrows = xt_ref[c_l * Q:(c_l + 1) * Q, :]
+            if brows.dtype != jnp.float32:
+                brows = brows.astype(jnp.float32)
+            if xrows.dtype != jnp.float32:
+                xrows = xrows.astype(jnp.float32)
+            v2row = jnp.sum(brows * xrows,
+                            axis=0, keepdims=True)   # [1, E_b]
             term = v2row * rt[il * O:(il + 1) * O, :]
             acc = term if acc is None else acc + term
         sl = slice(p * O, (p + 1) * O)
@@ -569,6 +586,13 @@ def _fused_pairwise_conv_bx_impl(h, w3, b3, basis, x, interpret, precision,
         precision = jax.lax.Precision.DEFAULT
         if interpret:
             h, w3 = h.astype(jnp.float32), w3.astype(jnp.float32)
+    if interpret:
+        # conv_bf16 under interpret: bit-identical to the kernel's
+        # load-then-upcast (quantize-then-f32 either way)
+        if basis.dtype == jnp.bfloat16:
+            basis = basis.astype(jnp.float32)
+        if x.dtype == jnp.bfloat16:
+            x = x.astype(jnp.float32)
 
     block_e, cb = _pick_blocks_bx(E, C, O, P, Q, F, mid)
     Cp = _round_up(C, cb)
@@ -716,6 +740,8 @@ def _bwd_a_kernel(ht_ref, h_ref, w3t_ref, b3t_ref, v2t_ref, gt_ref,
             dv2_ref[p, i:i + 1, :] = jnp.sum(
                 gp * r_i, axis=0, keepdims=True).astype(dv2_ref.dtype)
             vrow = v2t_ref[p, i:i + 1, :]            # [1, E_b]
+            if vrow.dtype != jnp.float32:
+                vrow = vrow.astype(jnp.float32)      # conv_bf16 storage
             term = vrow * gp                         # [O, E_b]
             dr_i = term if dr_i is None else dr_i + term
         # dW3 rows for this i: [O, E_b] @ [E_b, mid], accumulated over the
@@ -750,7 +776,10 @@ def _bwd_b_kernel(w3f_ref, v2t_ref, gt_ref, dh_ref, *, P, O, bif,
     for i in range(bif):
         dr_i = None
         for p in range(P):
-            term = v2t_ref[p, i:i + 1, :] * g[p * O:(p + 1) * O, :]
+            vrow = v2t_ref[p, i:i + 1, :]
+            if vrow.dtype != jnp.float32:
+                vrow = vrow.astype(jnp.float32)      # conv_bf16 storage
+            term = vrow * g[p * O:(p + 1) * O, :]
             dr_i = term if dr_i is None else dr_i + term
         # dH partial: [mid, O] @ [O, E_b]
         upd = jax.lax.dot_general(
@@ -770,7 +799,17 @@ def _bwd_b_kernel(w3f_ref, v2t_ref, gt_ref, dh_ref, *, P, O, bif,
 
 
 def _fused_pairwise_conv_bwd_impl(h, w3, b3, v2, g, interpret, precision):
+    # f32 gradient math: bf16 radial operands (radial_bf16) upcast
+    # exactly. A bf16 V2 (conv_bf16) STAYS bf16 through HBM — the
+    # backward kernels upcast rows in VMEM like the forward does, so the
+    # half-width saving on the dominant stream holds for the backward
+    # too (upcasting here would write a full f32 copy back to HBM first)
     h, w3 = h.astype(jnp.float32), w3.astype(jnp.float32)
+    g = g.astype(jnp.float32)
+    if v2.dtype == jnp.bfloat16 and interpret:
+        # interpret can't mix dtypes the way Mosaic lowers them; the
+        # pre-upcast is bit-identical to the kernels' row upcasts
+        v2 = v2.astype(jnp.float32)
     E, mid = h.shape
     _, IF, O = w3.shape
     P = v2.shape[1]

@@ -51,6 +51,7 @@ class AttentionSE3(nn.Module):
     fuse_basis: bool = False
     pallas_interpret: bool = False
     radial_bf16: bool = False
+    conv_bf16: bool = False
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -81,6 +82,7 @@ class AttentionSE3(nn.Module):
             edge_chunks=self.edge_chunks,
             fuse_basis=self.fuse_basis,
             radial_bf16=self.radial_bf16,
+            conv_bf16=self.conv_bf16,
             pallas_interpret=self.pallas_interpret)
 
         queries = LinearSE3(self.fiber, hidden_fiber, name='to_q')(features)
@@ -254,6 +256,7 @@ class AttentionBlockSE3(nn.Module):
     fuse_basis: bool = False
     pallas_interpret: bool = False
     radial_bf16: bool = False
+    conv_bf16: bool = False
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -280,6 +283,7 @@ class AttentionBlockSE3(nn.Module):
             edge_chunks=self.edge_chunks,
             fuse_basis=self.fuse_basis,
             radial_bf16=self.radial_bf16,
+            conv_bf16=self.conv_bf16,
             pallas_interpret=self.pallas_interpret,
             name='attn')(out, edge_info, rel_dist, basis, global_feats,
                          pos_emb, mask)

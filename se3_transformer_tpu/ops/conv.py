@@ -185,14 +185,17 @@ def _pc_bx_bwd(interpret, precision, res, g):
     h, w3, b3, basis, x = res
     E, P, Q, F = basis.shape
     C = x.shape[1]
-    v2 = jnp.einsum('epqf,ecq->epcf', basis, x,
+    # conv_bf16 residuals arrive bf16 (that's the remat/HBM saving);
+    # gradient math runs f32 on the exactly-upcast quantized values
+    b32, x32 = basis.astype(jnp.float32), x.astype(jnp.float32)
+    v2 = jnp.einsum('epqf,ecq->epcf', b32, x32,
                     precision=precision).reshape(E, P, C * F)
     dh, dw3, dv2, db3 = fused_pairwise_conv_bwd(h, w3, v2, g, b3=b3,
                                                 interpret=interpret,
                                                 precision=precision)
     dv2 = dv2.reshape(E, P, C, F)
-    dx = jnp.einsum('epqf,epcf->ecq', basis, dv2, precision=precision)
-    dbasis = jnp.einsum('ecq,epcf->epqf', x, dv2, precision=precision)
+    dx = jnp.einsum('epqf,epcf->ecq', b32, dv2, precision=precision)
+    dbasis = jnp.einsum('ecq,epcf->epqf', x32, dv2, precision=precision)
     return (dh.astype(h.dtype), dw3.astype(w3.dtype), db3.astype(b3.dtype),
             dbasis.astype(basis.dtype), dx.astype(x.dtype))
 
@@ -225,15 +228,17 @@ def _pc_bxf_bwd(pqf, interpret, precision, res, g):
     P, Q, F = pqf
     E = basis_flat.shape[0]
     C = x.shape[1]
-    b4 = basis_flat.reshape(E, P, F, Q)
-    v2 = jnp.einsum('epfq,ecq->epcf', b4, x,
+    # conv_bf16 residuals arrive bf16 (see _pc_bx_bwd)
+    b4 = basis_flat.astype(jnp.float32).reshape(E, P, F, Q)
+    x32 = x.astype(jnp.float32)
+    v2 = jnp.einsum('epfq,ecq->epcf', b4, x32,
                     precision=precision).reshape(E, P, C * F)
     dh, dw3, dv2, db3 = fused_pairwise_conv_bwd(h, w3, v2, g, b3=b3,
                                                 interpret=interpret,
                                                 precision=precision)
     dv2 = dv2.reshape(E, P, C, F)
     dx = jnp.einsum('epfq,epcf->ecq', b4, dv2, precision=precision)
-    dbasis = jnp.einsum('ecq,epcf->epfq', x, dv2,
+    dbasis = jnp.einsum('ecq,epcf->epfq', x32, dv2,
                         precision=precision).reshape(E, P * F * Q)
     return (dh.astype(h.dtype), dw3.astype(w3.dtype), db3.astype(b3.dtype),
             dbasis.astype(basis_flat.dtype), dx.astype(x.dtype))
@@ -285,6 +290,11 @@ class PairwiseConvSE3(nn.Module):
     # inputs are rotation-invariant, so this preserves equivariance to
     # ~1e-6 unlike a global bf16 policy (see radial_hidden docstring)
     radial_bf16: bool = False
+    # store the EQUIVARIANT kernel operands (V2 / basis / gathered
+    # features) bf16: halves the dominant HBM streams of the
+    # bandwidth-bound contraction, at ~1e-3 equivariance cost (the
+    # quantized tensors rotate). Opt-in perf knob; see _radial_contract.
+    conv_bf16: bool = False
     # False = reference-ordered unfused path through RadialFunc (per-edge
     # [c_out, c_in, F] kernel tensors, reference :326-343); the numerics
     # oracle for the fused paths above. Param layout differs.
@@ -331,7 +341,8 @@ class PairwiseConvSE3(nn.Module):
             out = _radial_contract_bx(
                 h, w3, b3, basis_slice, x,
                 pallas_interpret=self.pallas_interpret,
-                edge_chunks=self.edge_chunks, pqf=(P, Q, F))
+                edge_chunks=self.edge_chunks, pqf=(P, Q, F),
+                conv_bf16=self.conv_bf16)
             return jnp.swapaxes(out, -1, -2)  # [..., c_out, P]
 
         # V2[..., P, (i, f)] = sum_Q B[..., P, Q, f] x[..., i, Q]
@@ -340,22 +351,35 @@ class PairwiseConvSE3(nn.Module):
 
         out = _radial_contract(h, w3, b3, v2, pallas=self.pallas,
                                pallas_interpret=self.pallas_interpret,
-                               edge_chunks=self.edge_chunks)
+                               edge_chunks=self.edge_chunks,
+                               conv_bf16=self.conv_bf16)
         return jnp.swapaxes(out, -1, -2)  # [..., c_out, P]
 
 
 def _radial_contract(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
                      v2: jnp.ndarray, *, pallas: Optional[bool],
                      pallas_interpret: bool,
-                     edge_chunks: Optional[int]) -> jnp.ndarray:
+                     edge_chunks: Optional[int],
+                     conv_bf16: bool = False) -> jnp.ndarray:
     """Dispatch the fused radial-matmul x basis contraction:
     h [b,n,k,mid], w3 [mid,IF,O], b3 [IF,O], v2 [b,n,k,P,IF]
     -> [b,n,k,P,O] via the Pallas kernel / XLA einsums, optionally
     streaming the node axis in `edge_chunks` remat'd chunks (memory
     ceiling for huge channel counts: peak extra memory is one chunk's
-    R — XLA path — or just the kernel's VMEM tiles — Pallas path)."""
+    R — XLA path — or just the kernel's VMEM tiles — Pallas path).
+
+    conv_bf16 stores the V2 operand bf16 — HALF the dominant HBM stream
+    (the program is bandwidth-bound, scripts/flop_audit.py) — while the
+    apply math stays f32 on the quantized values. Unlike radial_bf16
+    (invariant inputs, ~1e-6 equivariance cost) this quantizes an
+    EQUIVARIANT tensor: expect ~1e-3-level equivariance error, the same
+    class as a global bf16 matmul policy. Opt-in accordingly."""
     P, IF = v2.shape[-2], v2.shape[-1]
     O = w3.shape[-1]
+    if conv_bf16:
+        # cast BEFORE the chunk-streaming split so the streamed HBM
+        # operand (and the remat residual) is already half-width
+        v2 = v2.astype(jnp.bfloat16)
 
     if _use_pallas(pallas, pallas_interpret):
         # The bias rides as its own [S, 1] kernel operand — folding it
@@ -396,14 +420,18 @@ def _radial_contract_bx(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
                         basis: jnp.ndarray, x: jnp.ndarray, *,
                         pallas_interpret: bool,
                         edge_chunks: Optional[int],
-                        pqf: Optional[Tuple[int, int, int]] = None
-                        ) -> jnp.ndarray:
+                        pqf: Optional[Tuple[int, int, int]] = None,
+                        conv_bf16: bool = False) -> jnp.ndarray:
     """Basis-fused dispatch (Pallas only): h [b,n,k,mid], w3 [mid,C*F,O],
     b3 [C*F,O], basis [b,n,k,P,Q,F] (or [b,n,k,P*F*Q] flat when it came
     from get_basis(layout='pfq_flat') — pqf supplies (P, Q, F) then),
     x [b,n,k,C,Q] -> [b,n,k,P,O]. Same contraction as _radial_contract
     on V2 = basis . x, but V2 never exists outside kernel VMEM (see
-    kernels.pallas_pairwise, bx/bxf variants)."""
+    kernels.pallas_pairwise, bx/bxf variants).
+
+    conv_bf16 stores the basis and gathered-feature operands bf16 (half
+    the kernel's biggest HBM streams; math stays f32 on the quantized
+    values — see _radial_contract's tradeoff note)."""
     flat = _basis_is_flat(basis, x)
     if flat:
         assert pqf is not None, 'flat basis needs explicit (P, Q, F)'
@@ -412,6 +440,11 @@ def _radial_contract_bx(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
         P, Q, F = basis.shape[-3:]
     C = x.shape[-2]
     O = w3.shape[-1]
+    if conv_bf16:
+        # before the chunk split: the streamed operands and the custom-vjp
+        # residuals are then half-width too
+        basis = basis.astype(jnp.bfloat16)
+        x = x.astype(jnp.bfloat16)
     # bias un-folded: separate [S, 1] kernel operand (see _radial_contract)
     w3c = w3.astype(h.dtype)
     prec = jax.config.jax_default_matmul_precision
@@ -465,6 +498,7 @@ class ConvSE3(nn.Module):
     shared_radial_hidden: bool = False
     fuse_basis: bool = False
     radial_bf16: bool = False
+    conv_bf16: bool = False
 
     @nn.compact
     def __call__(self, inp: Features, edge_info: EdgeInfo,
@@ -528,7 +562,8 @@ class ConvSE3(nn.Module):
                             hidden, w3, b3, basis_pair,
                             gathered[str(degree_in)],
                             pallas_interpret=self.pallas_interpret,
-                            edge_chunks=self.edge_chunks, pqf=(P, Q, F))
+                            edge_chunks=self.edge_chunks, pqf=(P, Q, F),
+                            conv_bf16=self.conv_bf16)
                         acc = y if acc is None else acc + y
                         continue
                     if _basis_is_flat(basis_pair, gathered[str(degree_in)]):
@@ -546,7 +581,8 @@ class ConvSE3(nn.Module):
                         jnp.concatenate(v2s, axis=-1),
                         pallas=self.pallas,
                         pallas_interpret=self.pallas_interpret,
-                        edge_chunks=self.edge_chunks)
+                        edge_chunks=self.edge_chunks,
+                        conv_bf16=self.conv_bf16)
                 acc = jnp.swapaxes(acc, -1, -2)  # [..., c_out, P]
             else:
                 acc = None
@@ -558,6 +594,7 @@ class ConvSE3(nn.Module):
                         edge_chunks=self.edge_chunks,
                         fuse_basis=self.fuse_basis,
                         radial_bf16=self.radial_bf16,
+                        conv_bf16=self.conv_bf16,
                         name=f'pair_{degree_in}_{degree_out}')(
                             edge_features,
                             basis[f'{degree_in},{degree_out}'],

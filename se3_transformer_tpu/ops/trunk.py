@@ -78,6 +78,7 @@ class SequentialTrunk(nn.Module):
     fuse_basis: bool = False
     pallas_interpret: bool = False
     radial_bf16: bool = False
+    conv_bf16: bool = False
 
     @nn.compact
     def __call__(self, x: Features, edge_info, rel_dist, basis,
@@ -114,6 +115,7 @@ class SequentialTrunk(nn.Module):
                 edge_chunks=self.edge_chunks,
                 fuse_basis=self.fuse_basis,
                 radial_bf16=self.radial_bf16,
+                conv_bf16=self.conv_bf16,
                 pallas_interpret=self.pallas_interpret,
                 name=f'attn_block{i}')(
                     x, edge_info, rel_dist, basis, global_feats, pos_emb,

@@ -32,11 +32,23 @@ from ..ops.neighbors import FINF, _top_k_smallest
 
 def _ring_knn_local(coors_q: jnp.ndarray, coors_src: jnp.ndarray,
                     mask_src: jnp.ndarray,
-                    k: int, axis_name: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                    nm_rows: Optional[jnp.ndarray],
+                    sp_rows: Optional[jnp.ndarray],
+                    k: int, axis_name: str,
+                    causal: bool = False
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-shard body (runs under shard_map). coors_q/coors_src are this
     device's [b, nl, 3] blocks, mask_src its [b, nl] source validity.
-    Returns (dist [b, nl, k], idx [b, nl, k]) with idx in GLOBAL node
-    coordinates; masked-out sources never occupy a neighbor slot."""
+    nm_rows/sp_rows are this device's QUERY-row shards of the full-width
+    per-pair predicates ([b, nl, N]): the user neighbor mask and the
+    bonded (sparse-adjacency) priority — each ring step slices the
+    source-block column window out of them. Returns (rank [b, nl, k],
+    idx [b, nl, k]) with idx in GLOBAL node coordinates; rank is the
+    MODIFIED ranking the dense path sorts by (reference
+    se3_transformer_pytorch.py:1257,1262,1267 — neighbor-mask
+    exclusions FINF, bonded 0, future FINF under causal), which is what
+    the `rank <= valid_radius` validity rule must consume; masked-out
+    sources never occupy a neighbor slot."""
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, nl, _ = coors_q.shape
@@ -46,6 +58,7 @@ def _ring_knn_local(coors_q: jnp.ndarray, coors_src: jnp.ndarray,
     # mark the running top-K as device-varying for shard_map's vma tracking
     best_d = jax.lax.pcast(best_d, (axis_name,), to='varying')
     best_i = jax.lax.pcast(best_i, (axis_name,), to='varying')
+    q_global = my_idx * nl + jnp.arange(nl, dtype=jnp.int32)
 
     def step(carry, t):
         best_d, best_i, src, m_src = carry
@@ -56,10 +69,27 @@ def _ring_knn_local(coors_q: jnp.ndarray, coors_src: jnp.ndarray,
         d = jnp.linalg.norm(coors_q[:, :, None] - src[:, None, :], axis=-1)
         src_global = src_owner * nl + jnp.arange(nl, dtype=jnp.int32)
         # exclude self-pairs (same global id) and masked-out sources
-        q_global = my_idx * nl + jnp.arange(nl, dtype=jnp.int32)
         self_mask = q_global[:, None] == src_global[None, :]
         d = jnp.where(self_mask[None], FINF, d)
         d = jnp.where(m_src[:, None, :], d, FINF)
+        # per-pair semantics, in the dense path's exact order (so e.g. a
+        # bonded pair overrides a neighbor-mask exclusion but loses to
+        # causal masking, matching ops/neighbors.select_neighbors)
+        col0 = src_owner * nl
+        if nm_rows is not None:
+            nm_blk = jax.lax.dynamic_slice_in_dim(nm_rows, col0, nl, axis=2)
+            d = jnp.where(nm_blk, d, FINF)
+        if sp_rows is not None:
+            sp_blk = jax.lax.dynamic_slice_in_dim(sp_rows, col0, nl, axis=2)
+            # a bond to a masked-out (padded) source must not resurrect
+            # it at rank 0 — the never-select-masked contract above wins
+            sp_blk = sp_blk & m_src[:, None, :]
+            d = jnp.where(sp_blk, 0., d)
+        if causal:
+            # self-excluded dense layout masks exactly source > query
+            # (reference :1267 via neighbors.select_neighbors)
+            future = src_global[None, :] > q_global[:, None]
+            d = jnp.where(future[None], FINF, d)
 
         cand_d = jnp.concatenate([best_d, d], axis=-1)
         cand_i = jnp.concatenate(
@@ -83,14 +113,37 @@ def _ring_knn_local(coors_q: jnp.ndarray, coors_src: jnp.ndarray,
 
 def ring_knn(coors: jnp.ndarray, k: int, mesh: Mesh,
              axis_name: str = 'sp',
-             mask: Optional[jnp.ndarray] = None
+             mask: Optional[jnp.ndarray] = None,
+             neighbor_mask: Optional[jnp.ndarray] = None,
+             sparse_mask: Optional[jnp.ndarray] = None,
+             causal: bool = False
              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Exact kNN (self excluded) over a node-sharded coordinate tensor.
+    """Exact kNN (self excluded) over a node-sharded coordinate tensor,
+    with the dense path's full ranking semantics.
 
     coors [b, n, 3] with n divisible by mesh.shape[axis_name]; optional
     mask [b, n] excludes padded nodes from ever being selected as
-    sources. Returns (dist [b, n, k], idx [b, n, k]) sharded the same
-    way; indices are global node ids and invalid slots carry dist=FINF.
+    sources. neighbor_mask/sparse_mask are optional FULL-width per-pair
+    predicates [b, n, n] (query-row sharded over the sp axis by
+    construction; the column axis stays local — they are the
+    user-supplied O(N^2) inputs of the adjacency configs, so holding a
+    row shard is the natural cost). causal masks future sources
+    (source id > query id), reference :1267.
+
+    Returns (rank [b, n, k], idx [b, n, k]) sharded the same way;
+    indices are global node ids. `rank` is the dense path's MODIFIED
+    ranking (bonded pairs 0, exclusions FINF): validity is
+    `rank <= valid_radius`, and the true geometry is recomputed from
+    `coors[idx]` by the caller. Plain-kNN callers can keep reading it
+    as a distance (invalid slots carry FINF).
+
+    INTENTIONAL divergence from the dense path on `mask`: masked-out
+    sources are FINF'd in the ranking here (never selected), while
+    select_neighbors lets them win slots by raw distance and only
+    invalidates them afterwards — so on padded inputs the ring fills
+    those slots with real farther neighbors instead of wasting them.
+    Parity with the dense path is exact for full masks (the tests'
+    contract); with padding the ring path strictly dominates.
     """
     n = coors.shape[1]
     sp = mesh.shape[axis_name]
@@ -100,12 +153,28 @@ def ring_knn(coors: jnp.ndarray, k: int, mesh: Mesh,
 
     spec = P(None, axis_name, None)
     mspec = P(None, axis_name)
-    fn = jax.shard_map(
-        partial(_ring_knn_local, k=k, axis_name=axis_name),
-        mesh=mesh,
-        in_specs=(spec, spec, mspec),
-        out_specs=(spec, spec))
-    return fn(coors, coors, mask)
+    in_specs = [spec, spec, mspec]
+    args = [coors, coors, mask]
+    # rows sharded like the queries, columns full: P(None, sp, None)
+    for pred in (neighbor_mask, sparse_mask):
+        if pred is not None:
+            assert pred.shape[-2:] == (n, n), pred.shape
+            in_specs.append(spec)
+            args.append(pred)
+    nm_pos = 3 if neighbor_mask is not None else None
+    sp_pos = (3 + (neighbor_mask is not None)) \
+        if sparse_mask is not None else None
+
+    def body(*ops):
+        return _ring_knn_local(
+            ops[0], ops[1], ops[2],
+            ops[nm_pos] if nm_pos is not None else None,
+            ops[sp_pos] if sp_pos is not None else None,
+            k=k, axis_name=axis_name, causal=causal)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                       out_specs=(spec, spec))
+    return fn(*args)
 
 
 def dense_knn(coors: jnp.ndarray, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:

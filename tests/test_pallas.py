@@ -699,3 +699,124 @@ def test_pairwise_block_picker_production_validated_picks():
     assert _pick_blocks_bx(32768, 64, 64, 7, 7, 7, 128) == (128, 8)
     # tiny shapes keep the full-axis fast path
     assert _pick_blocks(128, 16, 8, 3, 32) == (128, 16)
+
+
+# --------------------------------------------------------------------- #
+# conv_bf16: bf16 STORAGE of the equivariant kernel operands
+# --------------------------------------------------------------------- #
+
+
+def test_conv_bf16_kernel_quantized_oracle():
+    """bf16 V2/basis/x operands: the kernel upcasts rows after the VMEM
+    load, so the result must EXACTLY equal the f32 kernel run on the
+    quantize-then-upcast operands (same math, half the storage)."""
+    from se3_transformer_tpu.kernels.pallas_pairwise import (
+        fused_pairwise_conv_bxf,
+    )
+    rng = np.random.RandomState(3)
+    E, mid, I, F, O, P = 40, 16, 4, 3, 10, 7
+    C, Q = 4, 5
+    h = jnp.asarray(rng.normal(size=(E, mid)), jnp.float32)
+    w3 = jnp.asarray(rng.normal(size=(mid, I * F, O)), jnp.float32)
+    b3 = jnp.asarray(rng.normal(size=(I * F, O)), jnp.float32)
+    v2 = jnp.asarray(rng.normal(size=(E, P, I * F)), jnp.float32)
+    v2_q = v2.astype(jnp.bfloat16)
+
+    out_bf16 = fused_pairwise_conv(h, w3, v2_q, b3=b3, interpret=True)
+    out_oracle = fused_pairwise_conv(h, w3, v2_q.astype(jnp.float32),
+                                     b3=b3, interpret=True)
+    assert np.array_equal(np.asarray(out_bf16), np.asarray(out_oracle))
+    # and the quantization error vs full precision is bf16-sized, not junk
+    out_f32 = fused_pairwise_conv(h, w3, v2, b3=b3, interpret=True)
+    rel = np.abs(np.asarray(out_bf16 - out_f32)).max() \
+        / np.abs(np.asarray(out_f32)).max()
+    assert 0 < rel < 3e-2, rel
+
+    w3x = jnp.asarray(rng.normal(size=(mid, C * F, O)), jnp.float32)
+    b3x = jnp.asarray(rng.normal(size=(C * F, O)), jnp.float32)
+    basis = jnp.asarray(rng.normal(size=(E, P, F, Q)), jnp.float32)
+    flat = basis.reshape(E, P * F * Q)
+    x = jnp.asarray(rng.normal(size=(E, C, Q)), jnp.float32)
+    fq, xq = flat.astype(jnp.bfloat16), x.astype(jnp.bfloat16)
+    out_bf16 = fused_pairwise_conv_bxf(h, w3x, fq, xq, (P, Q, F), b3=b3x,
+                                       interpret=True)
+    out_oracle = fused_pairwise_conv_bxf(
+        h, w3x, fq.astype(jnp.float32), xq.astype(jnp.float32),
+        (P, Q, F), b3=b3x, interpret=True)
+    assert np.array_equal(np.asarray(out_bf16), np.asarray(out_oracle))
+
+
+def test_conv_bf16_model_paths_agree_and_train():
+    """Model-level conv_bf16: Pallas-interpret and XLA dispatch compute
+    the same quantize-then-f32 semantics; output stays close to the f32
+    model; gradients are finite through both custom-vjp backwards."""
+    from se3_transformer_tpu import SE3TransformerModule
+
+    rng = np.random.RandomState(19)
+    feats = jnp.asarray(rng.normal(size=(1, 12, 8)), jnp.float32)
+    coors = jnp.asarray(rng.normal(size=(1, 12, 3)) * 2, jnp.float32)
+    mask = jnp.ones((1, 12), bool)
+
+    def build(**kw):
+        return SE3TransformerModule(
+            dim=8, depth=1, num_degrees=3, num_neighbors=6, heads=2,
+            dim_head=4, input_degrees=1, output_degrees=2,
+            reduce_dim_out=True, differentiable_coors=True, **kw)
+
+    base = build()
+    params = base.init(jax.random.PRNGKey(0), feats, coors, mask=mask,
+                       return_type=1)['params']
+    out_f32 = base.apply({'params': params}, feats, coors, mask=mask,
+                         return_type=1)
+
+    m_pallas = build(conv_bf16=True, pallas_interpret=True, pallas=True)
+    m_xla = build(conv_bf16=True, pallas=False)
+    out_p = m_pallas.apply({'params': params}, feats, coors, mask=mask,
+                           return_type=1)
+    out_x = m_xla.apply({'params': params}, feats, coors, mask=mask,
+                        return_type=1)
+    # identical quantization point, f32 math both sides: tight agreement
+    assert np.abs(np.asarray(out_p - out_x)).max() < 1e-4
+    # bf16-sized deviation from the f32 model, not garbage
+    denom = np.abs(np.asarray(out_f32)).max()
+    rel = np.abs(np.asarray(out_p - out_f32)).max() / denom
+    assert 0 < rel < 5e-2, rel
+
+    def loss(p, module):
+        out = module.apply({'params': p}, feats, coors, mask=mask,
+                           return_type=1)
+        return (out ** 2).sum()
+
+    for module in (m_pallas, m_xla):
+        g = jax.grad(loss)(params, module)
+        leaves = jax.tree_util.tree_leaves(g)
+        assert all(bool(jnp.isfinite(leaf).all()) for leaf in leaves)
+        assert any(float(jnp.abs(leaf).max()) > 0 for leaf in leaves)
+
+
+def test_conv_bf16_equivariance_cost_bounded():
+    """conv_bf16 quantizes equivariant tensors, so its equivariance error
+    is ~bf16-sized — orders above the f32 paths' ~1e-6 but bounded. The
+    documented tradeoff (ops/conv.py): this test pins the magnitude so a
+    regression to garbage (or a silent no-op of the flag) is caught."""
+    from se3_transformer_tpu import SE3TransformerModule
+    from se3_transformer_tpu.utils.validation import equivariance_l2
+
+    rng = np.random.RandomState(23)
+    feats = jnp.asarray(rng.normal(size=(1, 16, 8)), jnp.float32)
+    coors = jnp.asarray(rng.normal(size=(1, 16, 3)) * 2, jnp.float32)
+    mask = jnp.ones((1, 16), bool)
+    kw = dict(dim=8, depth=1, num_degrees=3, num_neighbors=6, heads=2,
+              dim_head=4, input_degrees=1, output_degrees=2,
+              reduce_dim_out=True, differentiable_coors=True)
+    base = SE3TransformerModule(**kw)
+    params = base.init(jax.random.PRNGKey(1), feats, coors, mask=mask,
+                       return_type=1)['params']
+    err_base = equivariance_l2(base, params, feats, coors, mask)
+    m = SE3TransformerModule(conv_bf16=True, pallas_interpret=True,
+                             pallas=True, **kw)
+    err_bf16 = equivariance_l2(m, params, feats, coors, mask)
+    assert err_base < 1e-4
+    assert err_bf16 < 5e-2
+    # the flag must actually quantize (a silent no-op would match f32)
+    assert err_bf16 > err_base

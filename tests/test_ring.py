@@ -134,3 +134,136 @@ def test_sequence_parallel_ring_long_context():
     out = np.asarray(out)
     assert out.shape == (1, n, 8, 3)
     assert np.isfinite(out).all()
+
+
+# --------------------------------------------------------------------- #
+# ring semantics beyond plain kNN (VERDICT r4 next #3): sparse-adjacency
+# bonded priority, N-hop rings + adj embeddings, causal, neighbor_mask,
+# edges — each vs the dense path on identical params at n=256
+# --------------------------------------------------------------------- #
+
+
+def _ring_vs_dense(n=256, k=6, seed=11, tol=2e-5, adj=None, edges=None,
+                   neighbor_mask=None, **model_kw):
+    import jax
+    from se3_transformer_tpu import SE3TransformerModule
+
+    rng = np.random.RandomState(seed)
+    feats = jnp.asarray(rng.normal(size=(1, n, 8)), jnp.float32)
+    coors = jnp.asarray(rng.normal(size=(1, n, 3)) * 3, jnp.float32)
+    mask = jnp.ones((1, n), bool)
+
+    mesh = make_mesh(dp=1, sp=8, tp=1)
+    kw = dict(dim=8, depth=1, attend_self=True, num_neighbors=k,
+              num_degrees=2, output_degrees=2, **model_kw)
+    dense = SE3TransformerModule(**kw)
+    ring = SE3TransformerModule(**kw, sequence_parallel='ring', mesh=mesh)
+
+    call_kw = dict(mask=mask, return_type=1)
+    if adj is not None:
+        call_kw['adj_mat'] = adj
+    if edges is not None:
+        call_kw['edges'] = edges
+    if neighbor_mask is not None:
+        call_kw['neighbor_mask'] = neighbor_mask
+
+    params = dense.init(jax.random.PRNGKey(7), feats, coors,
+                        **call_kw)['params']
+    out_d = dense.apply({'params': params}, feats, coors, **call_kw)
+    out_r = jax.jit(lambda p: ring.apply({'params': p}, feats, coors,
+                                         **call_kw))(params)
+    diff = np.abs(np.asarray(out_d) - np.asarray(out_r)).max()
+    assert diff < tol, diff
+    return out_d
+
+
+def _chain_adjacency(n):
+    """Path graph: i ~ i+1 (2 bonded per interior row — under any
+    max_sparse cap >= 2 the sparse selection is jitter-independent, so
+    ring and dense pick identical bonded sets)."""
+    a = np.zeros((n, n), bool)
+    idx = np.arange(n - 1)
+    a[idx, idx + 1] = True
+    a[idx + 1, idx] = True
+    return jnp.asarray(a[None])
+
+
+def test_ring_sparse_adjacency_matches_dense():
+    n = 256
+    _ring_vs_dense(n=n, adj=_chain_adjacency(n),
+                   attend_sparse_neighbors=True, max_sparse_neighbors=2)
+
+
+def test_ring_causal_matches_dense():
+    _ring_vs_dense(causal=True)
+
+
+def test_ring_neighbor_mask_matches_dense():
+    n = 256
+    rng = np.random.RandomState(13)
+    nm = jnp.asarray(rng.rand(1, n, n) > 0.3)
+    _ring_vs_dense(n=n, neighbor_mask=nm)
+
+
+def test_ring_adj_degrees_and_edges_match_dense():
+    """2-hop adjacency expansion + ring-label embeddings + continuous
+    edge features, all flowing through the ring gather."""
+    n = 256
+    rng = np.random.RandomState(17)
+    edges = jnp.asarray(rng.normal(size=(1, n, n, 3)), jnp.float32)
+    _ring_vs_dense(n=n, adj=_chain_adjacency(n),
+                   attend_sparse_neighbors=True, max_sparse_neighbors=2,
+                   num_adj_degrees=2, adj_dim=4, edge_dim=3, edges=edges)
+
+
+def test_ring_sparse_bonded_beyond_radius_stay_valid():
+    """A bonded pair farther than valid_radius must still be selected and
+    VALID (rank 0 <= radius) — the dense :1262 semantics the ring merge
+    now carries."""
+    import jax
+    from se3_transformer_tpu import SE3TransformerModule
+
+    n = 32
+    # two distant clusters; node 0 and node n-1 are bonded across them
+    rng = np.random.RandomState(19)
+    base = rng.normal(size=(1, n, 3)).astype(np.float32)
+    base[:, n // 2:] += 100.0
+    coors = jnp.asarray(base)
+    feats = jnp.asarray(rng.normal(size=(1, n, 8)), jnp.float32)
+    mask = jnp.ones((1, n), bool)
+    a = np.zeros((n, n), bool)
+    a[0, n - 1] = a[n - 1, 0] = True
+    mesh = make_mesh(dp=1, sp=8, tp=1)
+    kw = dict(dim=8, depth=1, attend_self=True, num_neighbors=4,
+              num_degrees=2, output_degrees=2, attend_sparse_neighbors=True,
+              max_sparse_neighbors=1, valid_radius=10.0)
+    dense = SE3TransformerModule(**kw)
+    ring = SE3TransformerModule(**kw, sequence_parallel='ring', mesh=mesh)
+    call_kw = dict(mask=mask, adj_mat=jnp.asarray(a[None]), return_type=1)
+    params = dense.init(jax.random.PRNGKey(3), feats, coors,
+                        **call_kw)['params']
+    out_d = dense.apply({'params': params}, feats, coors, **call_kw)
+    out_r = ring.apply({'params': params}, feats, coors, **call_kw)
+    assert np.abs(np.asarray(out_d) - np.asarray(out_r)).max() < 2e-5
+    # and the cross-cluster bond actually influenced the output: zeroing
+    # the adjacency changes node 0's output (the bond is out of radius,
+    # so only the bonded-priority path can carry it)
+    no_bond = dense.apply({'params': params}, feats, coors, mask=mask,
+                          adj_mat=jnp.zeros_like(call_kw['adj_mat']),
+                          return_type=1)
+    assert np.abs(np.asarray(out_d)[0, 0] - np.asarray(no_bond)[0, 0]).max() \
+        > 1e-6
+
+
+def test_ring_sparse_jitter_parity_over_cap():
+    """A hub node with MORE bonds than max_sparse_neighbors: the jittered
+    top-k must pick the same bonded subset in both branches (the noise is
+    drawn in the dense layout and scattered — models/se3_transformer.py
+    _adjacency_predicates), so ring==dense even where selection depends
+    on the tie-break jitter."""
+    n = 64
+    a = np.zeros((n, n), bool)
+    a[0, 1:9] = True  # node 0 has 8 bonds, cap is 3
+    a[1:9, 0] = True
+    _ring_vs_dense(n=n, adj=jnp.asarray(a[None]),
+                   attend_sparse_neighbors=True, max_sparse_neighbors=3)

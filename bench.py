@@ -443,11 +443,10 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
     }
     # loss-trajectory sanity: adam at 1e-4 on this objective decreases
     # monotonically-ish from the first step; a flat or garbage sequence
-    # means the executable did not run the program the label claims
-    record['loss_first'] = round(losses[0], 2)
-    record['loss_last'] = round(losses[-1], 2)
-    record['loss_decreased'] = bool(losses[-1] < losses[0]) \
-        and all(np.isfinite(losses))
+    # means the executable did not run the program the label claims.
+    # Shared definition with run_baselines (utils.helpers)
+    from se3_transformer_tpu.utils.helpers import loss_trajectory_fields
+    record.update(loss_trajectory_fields(losses))
     if eq_scope:
         record['equivariance_scope'] = eq_scope
     if device_kind:

@@ -79,31 +79,58 @@ def run_config(name, module, n, steps, rng, batch=1):
     @jax.jit
     def step(params, opt_state, key):
         loss, grads = jax.value_and_grad(loss_fn)(params, coors, key)
+        gnorm = optax.global_norm(grads)
         updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        return optax.apply_updates(params, updates), opt_state, loss, gnorm
 
     key = jax.random.PRNGKey(1)
     t_c0 = time.time()
-    params, opt_state, loss = step(params, opt_state, key)
+    params, opt_state, loss, gnorm = step(params, opt_state, key)
     jax.block_until_ready(loss)
     compile_s = time.time() - t_c0
 
     from se3_transformer_tpu.utils.helpers import fetch_sync
+    # training-sanity signal travels with EVERY row (VERDICT r4 next #4:
+    # fast-but-diverging must be visible in the record): per-step losses
+    # and grad norms stay on device during the timed window (no extra
+    # host syncs) and are floated after the clock stops
+    losses, gnorms = [], []
     t0 = time.time()
     for _ in range(steps):
         key, sub = jax.random.split(key)
-        params, opt_state, loss = step(params, opt_state, sub)
+        params, opt_state, loss, gnorm = step(params, opt_state, sub)
+        losses.append(loss)
+        gnorms.append(gnorm)
     # host-materialize inside the window (loss gates the last forward, a
     # small param leaf gates the optimizer tail): block_until_ready was
     # observed to return tens of seconds early on this runtime
-    loss = float(loss)
+    loss = float(losses[-1])
     fetch_sync(min(jax.tree_util.tree_leaves(params), key=lambda l: l.size))
     dt = time.time() - t0
+    losses = [float(l) for l in losses[:-1]] + [loss]
+    gnorms = [float(g) for g in gnorms]
     assert np.isfinite(loss), f'{name}: non-finite loss'
-    return dict(config=name, nodes=n, steps=steps, loss=loss,
-                step_ms=round(dt / steps * 1e3, 2),
-                nodes_steps_per_sec=round(b * n * steps / dt, 2),
-                compile_s=round(compile_s, 1))
+    from se3_transformer_tpu.utils.helpers import loss_trajectory_fields
+    rec = dict(config=name, nodes=n, steps=steps, loss=loss,
+               step_ms=round(dt / steps * 1e3, 2),
+               nodes_steps_per_sec=round(b * n * steps / dt, 2),
+               compile_s=round(compile_s, 1),
+               **loss_trajectory_fields(losses),
+               grad_norm_first=round(gnorms[0], 4),
+               grad_norm_last=round(gnorms[-1], 4),
+               grad_norms_finite=bool(np.isfinite(gnorms).all()))
+    # provenance (ADVICE r4 #5): a re-captured row that regresses purely
+    # from a different host (1-core container) or code revision must be
+    # explainable from the JSON alone
+    try:
+        import tpu_probe
+        rev = tpu_probe.package_fingerprint()
+        if rev:
+            rec['code_rev'] = rev
+    except Exception:
+        pass
+    rec['host_cpus'] = os.cpu_count()
+    return rec
 
 
 def main(argv=None):

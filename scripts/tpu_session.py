@@ -227,8 +227,13 @@ def main():
     def save_bench(rec):
         # persist to the repo so the numbers survive a tunnel death in a
         # later stage. JSONL append: a crash mid-write can only lose the
-        # line being written, never earlier sessions' records — and a
-        # save problem must not mark a completed bench as failed
+        # line being written, never earlier sessions' records — and an IO
+        # problem must not mark a completed bench as failed. The SCHEMA
+        # gate is different: an on-chip record without equivariance_l2
+        # raises OUT of this function (VERDICT r4 next #5) — the stage
+        # fails loudly and the record stays in the log only.
+        from _flagship_common import validate_bench_record
+        validate_bench_record(rec)
         try:
             import json
             path = os.path.join(os.path.dirname(here),
@@ -256,10 +261,11 @@ def main():
                 # proved fits (0 = unchunked)
                 if edge_chunks is not None:
                     os.environ['SE3_TPU_BENCH_CHUNKS'] = str(edge_chunks)
-                # the twin equivariance number is already in this
-                # session's fast record — don't re-compile it over the
-                # tunnel
-                os.environ['SE3_TPU_BENCH_EQ'] = '0'
+                # the reduced twin DOES run for batched records now: its
+                # compile is jit-cached from this session's bench_fast
+                # stage (identical twin config), and a null
+                # equivariance_l2 would be refused by the schema gate
+                # (VERDICT r4 next #5 — the round-4 b=2/ec=8 nulls)
             if cb16:
                 # conv_bf16 A/B arm (VERDICT r4 next #2): same recipe,
                 # bf16-STORED equivariant operands, labelled cb16
@@ -273,7 +279,9 @@ def main():
                 if batch is not None:
                     os.environ.pop('SE3_TPU_BENCH_BATCH', None)
                     os.environ.pop('SE3_TPU_BENCH_CHUNKS', None)
-                    os.environ.pop('SE3_TPU_BENCH_EQ', None)
+                    # NOTE: SE3_TPU_BENCH_EQ deliberately NOT popped —
+                    # this stage no longer sets it, and popping would
+                    # erase an operator-provided opt-in for later stages
                 if cb16:
                     os.environ.pop('SE3_TPU_BENCH_CB16', None)
         return stage
@@ -281,8 +289,28 @@ def main():
     def stage_baselines():
         import run_baselines
         out_path = os.path.join(os.path.dirname(here), 'BASELINES_TPU.json')
-        run_baselines.main(['--steps', '5', '--out', out_path])
+        args = ['--steps', '5', '--out', out_path]
+        if 'convergence' in active_stage_keys:
+            # the convergence stage reruns the two flagship configs at 50
+            # steps and merge-on-write replaces the 5-step rows — running
+            # them here too would double the session's costliest configs
+            from se3_transformer_tpu.training.recipes import RECIPES
+            rest = [nm for nm in RECIPES
+                    if nm not in ('flagship', 'flagship_fast')]
+            args += ['--configs'] + rest
+        run_baselines.main(args)
         log(f'run_baselines: completed ({out_path})')
+
+    def stage_convergence():
+        # VERDICT r4 next #4: >=50 flagship steps so the banked rows carry
+        # a real convergence signal (loss trajectory + grad norms), not a
+        # 5-step blip. Merge-on-write keeps the other configs' rows.
+        import run_baselines
+        out_path = os.path.join(os.path.dirname(here), 'BASELINES_TPU.json')
+        run_baselines.main(['--steps', '50',
+                            '--configs', 'flagship', 'flagship_fast',
+                            '--out', out_path])
+        log(f'run_baselines convergence (50 steps): completed ({out_path})')
 
     probe_path = os.path.join(os.path.dirname(here), 'PROBE_TPU.jsonl')
 
@@ -303,6 +331,66 @@ def main():
         else:
             b, ec = best
             make_bench_stage(fast=True, batch=b, edge_chunks=ec)()
+
+    def stage_block_ab():
+        """VERDICT r4 next #9: one same-session confirmation pair for the
+        (512,16) conservative forward-block default — the round-4
+        adoption rested on A/Bs under tunnel noise (2.3x spread on
+        identical code). Both arms run back-to-back in THIS session:
+        default (the 7 MiB picker's (512,16)) vs the pre-adoption
+        (512,8). The kernel jit wrappers' caches are cleared between
+        arms — the env override is read at trace time, so a stale traced
+        kernel would silently measure the same program twice
+        (kernel_tune.py learned this the hard way)."""
+        import json
+        import bench
+        from se3_transformer_tpu.kernels import pallas_pairwise as pp
+
+        def clear_kernel_caches():
+            cleared = 0
+            for nm in ('fused_pairwise_conv', 'fused_pairwise_conv_bx',
+                       'fused_pairwise_conv_bxf', 'fused_pairwise_conv_bwd'):
+                f = getattr(pp, nm, None)
+                if f is not None and hasattr(f, 'clear_cache'):
+                    f.clear_cache()
+                    cleared += 1
+            for nm in ('_fwd_partitioned', '_bx_partitioned',
+                       '_bxf_partitioned', '_bwd_partitioned'):
+                f = getattr(pp, nm, None)
+                if f is not None and hasattr(f, 'cache_clear'):
+                    f.cache_clear()
+                    cleared += 1
+            if cleared == 0:
+                # a silent no-op would let both arms reuse arm 1's traced
+                # kernel and bank a pair that compared identical programs
+                raise RuntimeError(
+                    'clear_kernel_caches cleared nothing — jit wrapper '
+                    'cache API changed; block A/B would be invalid')
+
+        path = os.path.join(os.path.dirname(here), 'BLOCK_AB.jsonl')
+        arms = [('default_512_16', {}),
+                ('override_512_8', {'SE3_TPU_BLOCK_E': '512',
+                                    'SE3_TPU_BLOCK_IF': '8'})]
+        for arm, env in arms:
+            saved = {k: os.environ.pop(k) for k in list(os.environ)
+                     if k.startswith('SE3_TPU_BLOCK_')}
+            os.environ.update(env)
+            try:
+                clear_kernel_caches()
+                rec = bench.main('tpu', fast=False)
+                rec['arm'] = arm
+                rec['override_env'] = env
+                rec['session'] = 'same_session_pair'
+                with open(path, 'a') as f:
+                    f.write(json.dumps(rec) + '\n')
+                log(f'block_ab {arm}: {rec["value"]} '
+                    f'({rec["step_ms"]} ms/step)')
+            finally:
+                for k in list(os.environ):
+                    if k.startswith('SE3_TPU_BLOCK_'):
+                        os.environ.pop(k)
+                os.environ.update(saved)
+        clear_kernel_caches()
 
     def stage_kernel_tune():
         import kernel_tune
@@ -358,10 +446,15 @@ def main():
          'streams the biggest V2 tensor, so the bandwidth win peaks here)',
          make_bench_stage(fast=False, cb16=True), True),
         ('baselines', 'baseline configs', stage_baselines, True),
+        ('convergence', 'flagship 50-step convergence rows',
+         stage_convergence, True),
         ('probe', 'knob/width/batch probe (edge_chunks x dim x batch)',
          stage_probe, True),
         ('batched', 'batched flagship record (best batch from probe)',
          stage_batched_record, True),
+        ('block_ab',
+         'conservative (512,16) vs (512,8) same-session block A/B',
+         stage_block_ab, True),
         ('tune', 'kernel block-size tuning sweep', stage_kernel_tune, True),
         ('checks', 'tpu_checks', stage_tpu_checks, True),
         ('timings', 'stage timings (flagship bench config)',
@@ -385,6 +478,9 @@ def main():
             log('ERROR: stage filter matched no stages — aborting')
             return 2
         log(f'stage filter: {[key for key, *_ in stages]}')
+    # closures (stage_baselines) consult this to avoid duplicating work
+    # another active stage owns
+    active_stage_keys = {key for key, *_ in stages}
     stages = [(title, fn, fatal) for _key, title, fn, fatal in stages]
     for title, fn, fatal in stages:
         if not run_stage(title, fn, fatal=fatal):

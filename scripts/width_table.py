@@ -141,6 +141,89 @@ def measure_point(jax, mesh, dim, n, k, tp, execute=False):
     return rec
 
 
+def weak_scaling_point(jax, n_devices, per_device_nodes, dim, k, steps=3):
+    """One weak-scaling row (VERDICT r4 next #8): sp=n_devices ring-path
+    training step at FIXED per-device node count, executed for wall-clock
+    + XLA per-shard memory. All virtual devices share this host's cores,
+    so ideal weak scaling here is wall-clock LINEAR in total nodes (not
+    flat); the rows record step_s only — the overhead factor
+    step_s / (sp * step_s_at_sp1) is derived downstream from the sp=1
+    row (docs/PERF.md does this), and per-shard memory should stay
+    ~flat (the actual weak-scaling claim)."""
+    import time as _time
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from se3_transformer_tpu.parallel.mesh import make_mesh
+    from se3_transformer_tpu.parallel.sharding import make_sharded_train_step
+    from se3_transformer_tpu.training import recipes
+
+    n = per_device_nodes * n_devices
+    mesh = make_mesh(jax.devices()[:n_devices], dp=1, tp=1)
+    module = recipes.RECIPES['flagship_fast'](
+        dim=dim, num_neighbors=k, output_degrees=2, reduce_dim_out=True,
+        depth=1, sequence_parallel='ring', mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    node_spec = P(None, 'sp', None)
+    feats = jax.device_put(
+        jnp.asarray(rng.normal(size=(1, n, dim)), jnp.float32),
+        NamedSharding(mesh, node_spec))
+    coords = jax.device_put(
+        jnp.asarray(np.cumsum(rng.normal(size=(1, n, 3)), axis=1),
+                    jnp.float32), NamedSharding(mesh, node_spec))
+    masks = jax.device_put(jnp.ones((1, n), bool),
+                           NamedSharding(mesh, P(None, 'sp')))
+
+    def loss_fn(params, data, key):
+        noise = jax.random.normal(key, data['coords'].shape,
+                                  data['coords'].dtype)
+        noised = data['coords'] + noise
+        out = module.apply({'params': params}, data['seqs'], noised,
+                           mask=data['masks'], return_type=1)
+        return (((noised + out) - data['coords']) ** 2).sum(-1).mean(), {}
+
+    params = jax.jit(module.init, static_argnames=('return_type',))(
+        jax.random.PRNGKey(0), feats, coords, mask=masks,
+        return_type=1)['params']
+    optimizer = optax.adam(1e-4)
+    opt_state = optimizer.init(params)
+    step = make_sharded_train_step(loss_fn, optimizer, donate=False)
+    data = dict(seqs=feats, coords=coords, masks=masks)
+    key = jax.random.PRNGKey(1)
+
+    t0 = _time.time()
+    compiled = step.lower(params, opt_state, data, key).compile()
+    compile_s = _time.time() - t0
+    rec = dict(weak_scaling=True, devices=n_devices, sp=n_devices,
+               per_device_nodes=per_device_nodes, n=n, dim=dim, k=k,
+               depth=1, compile_s=round(compile_s, 1),
+               host_cpus=os.cpu_count(), backend='cpu-spmd')
+    try:
+        ma = compiled.memory_analysis()
+        if isinstance(ma, (list, tuple)):
+            ma = ma[0]
+        temp = getattr(ma, 'temp_size_in_bytes', 0) or 0
+        arg = getattr(ma, 'argument_size_in_bytes', 0) or 0
+        rec['per_shard_temp_mb'] = round(temp / 2**20, 1)
+        rec['per_shard_total_gb'] = round((temp + arg) / 2**30, 3)
+    except Exception as e:  # noqa: BLE001 - memory analysis best-effort
+        rec['memory_analysis_error'] = f'{type(e).__name__}: {e}'[:200]
+    out = compiled(params, opt_state, data, key)  # warmup
+    jax.block_until_ready(out[2])
+    t0 = _time.time()
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        out = compiled(params, opt_state, data, sub)
+    jax.block_until_ready(out[2])
+    rec['step_s'] = round((_time.time() - t0) / steps, 3)
+    rec['loss_finite'] = bool(jax.numpy.isfinite(out[2]))
+    return rec
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument('--devices', type=int, required=True)
@@ -160,9 +243,23 @@ def main(argv=None):
                          'nodes, see --exec-nodes)')
     ap.add_argument('--exec-nodes', type=int, default=128)
     ap.add_argument('--out', default=os.path.join(REPO, 'WIDTH_TABLE.jsonl'))
+    ap.add_argument('--weak-scaling', action='store_true',
+                    help='one weak-scaling row: sp=devices ring path at '
+                         'fixed per-device nodes, executed (fresh process '
+                         'per device count)')
+    ap.add_argument('--per-device-nodes', type=int, default=256)
+    ap.add_argument('--weak-dim', type=int, default=16)
     args = ap.parse_args(argv)
 
     jax = _setup(args.devices)
+
+    if args.weak_scaling:
+        rec = weak_scaling_point(jax, args.devices, args.per_device_nodes,
+                                 args.weak_dim, min(args.k, 8))
+        print(json.dumps(rec), flush=True)
+        with open(args.out, 'a') as f:
+            f.write(json.dumps(rec) + '\n')
+        return
     from se3_transformer_tpu.parallel.mesh import make_mesh
     devices = jax.devices()[:args.devices]
     assert len(devices) >= args.devices, \

@@ -294,3 +294,17 @@ def fetch_sync_tail(tree) -> None:
     leaves = jax.tree_util.tree_leaves(tree)
     if leaves:
         _np.asarray(leaves[0].ravel()[:1])
+
+
+def loss_trajectory_fields(losses) -> dict:
+    """Training-sanity fields shared by every banked perf record
+    (bench.py, scripts/run_baselines.py): a fast-but-diverging run must
+    be visible from the JSON alone (VERDICT r4 next #4). One definition
+    so the two record streams can never silently disagree."""
+    import numpy as np
+    return dict(
+        loss_first=round(float(losses[0]), 4),
+        loss_last=round(float(losses[-1]), 4),
+        loss_decreased=bool(losses[-1] < losses[0])
+        and bool(np.all(np.isfinite(losses))),
+    )

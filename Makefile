@@ -4,8 +4,11 @@
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
 
-test-fast:         ## default gate: skips the `slow` tier (config fuzz, full equivariance matrix)
-	python -m pytest tests/ -q -m "not slow"
+test-fast:         ## <5-min single-core gate: kernel/math numerics + model smokes (skips slow + heavy tiers)
+	python -m pytest tests/ -q -m "not slow and not heavy"
+
+test-heavy:        ## the compile-heavy model-level integration tier
+	python -m pytest tests/ -q -m "heavy"
 
 bench:             ## one-line JSON benchmark (TPU if available, CPU fallback)
 	python bench.py

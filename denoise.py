@@ -36,7 +36,15 @@ def main():
     ap.add_argument('--dataset', type=str, default=None,
                     help='train from a PointCloudDataset .npz (see '
                          'training.dataset); --nodes becomes the bucket size')
+    ap.add_argument('--cpu', action='store_true',
+                    help='force the CPU backend (the axon TPU tunnel is '
+                         'single-client and BLOCKS at init when wedged or '
+                         'held by another process; same escape hatch as '
+                         'scripts/run_baselines.py --cpu)')
     args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
 
     cfg = DenoiseConfig(num_nodes=args.nodes, batch_size=args.batch,
                         num_degrees=args.degrees, use_mesh=args.mesh,

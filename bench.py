@@ -20,14 +20,17 @@ import time
 # nodes*steps/sec/chip anchors on TPU v5e-1, rolled forward each round so
 # vs_baseline measures THIS round's progress against the last round's
 # banked session records (each path compares against its own record —
-# they run different programs). Round-4 close session (02:40-03:02Z,
-# code_rev 8a81188, BENCH_SESSION.jsonl): conservative 296.26
-# (step_ms 3456.44, eq 9.18e-07), fast 536.69 (step_ms 1907.99,
-# eq 1.07e-06) — bias un-folding + unchunked re-cut +
-# remat_policy='save_conv_outputs' + (512,16) forward blocks.
-# Round-3 anchors were 262.38 / 309.57.
-RECORD = 296.26
-FAST_RECORD = 536.69
+# they run different programs). Round-5 session (16:06-17:11Z,
+# code_rev 4fff503, BENCH_SESSION.jsonl): conservative 337.07 (the
+# idle-host block_ab arm; the bench-stage row was 331.11), fast 536.76.
+# ESTIMATOR NOTE: chip timing moved to best-of-two windows this round
+# (tunnel noise is one-sided); the fast anchor re-measured 536.94 under
+# it — indistinguishable — and both anchors are best *observed* windows,
+# so best-of-two vs them carries no built-in tailwind beyond the ~1-2%
+# single-session spread. Round-4 anchors were 296.26 / 536.69; round-3
+# 262.38 / 309.57.
+RECORD = 337.07
+FAST_RECORD = 536.76
 
 
 def _probe_device(q):
@@ -335,27 +338,57 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
     # measured an impossible 411 ms conservative step and the losses
     # that would have exposed (or exonerated) it were discarded. The
     # trajectory now travels with the record.
+    # Two timed windows, rate from the BEST one: per-step dispatch rides
+    # the device tunnel, whose latency spikes are strictly additive —
+    # min-over-windows removes one-sided noise (the 16:57Z rehearsal
+    # measured 519 on code that benched 537 in-session minutes earlier).
+    # Both window rates travel with the record. Training state carries
+    # across windows, so the loss trajectory spans all 2*steps steps.
     losses = []
-    t0 = time.time()
-    for _ in range(steps):
-        key, sub = jax.random.split(key)
-        params, opt_state, loss, _ = exec_fn(params, opt_state, data, sub)
-        losses.append(loss)
-    # close the window by HOST-MATERIALIZING the chain tail, not
-    # block_until_ready: the axon runtime returned from block tens of
-    # seconds early on fresh programs (utils.helpers.fetch_sync), which
-    # produced two impossible records (411/401 ms "steps") before the
-    # loss trajectory exposed it. Only the TAIL is fetched inside the
-    # window (final loss gates the last forward, one small param leaf
-    # gates the optimizer tail) — fetching every loss here would add a
-    # tunnel round-trip per step to dt; the earlier losses are floated
-    # after the clock stops.
-    last = float(losses[-1])
-    fetch_sync(min(jax.tree_util.tree_leaves(params), key=lambda l: l.size))
-    dt = time.time() - t0
-    losses = [float(l) for l in losses[:-1]] + [last]
+    window_rates = []
+    # the CPU liveness-fallback toy keeps its FROZEN single-window
+    # definition (round-over-round trend comparability); only chip
+    # records get the best-of-two estimator. Gate on on_chip (which
+    # selected the program being timed), not the in-process backend —
+    # a cpu-probed run can still find an accelerator in process (see
+    # the eq-twin guard below) but it measured the TOY workload
+    n_windows = 2 if on_chip else 1
+    for _ in range(n_windows):
+        win_losses = []
+        try:
+            t0 = time.monotonic()
+            for _ in range(steps):
+                key, sub = jax.random.split(key)
+                params, opt_state, loss, _ = exec_fn(
+                    params, opt_state, data, sub)
+                win_losses.append(loss)
+            # close the window by HOST-MATERIALIZING the chain tail, not
+            # block_until_ready: the axon runtime returned from block tens
+            # of seconds early on fresh programs (utils.helpers.fetch_sync),
+            # which produced two impossible records (411/401 ms "steps")
+            # before the loss trajectory exposed it. Only the TAIL is
+            # fetched inside the window (final loss gates the last forward,
+            # one small param leaf gates the optimizer tail) — fetching
+            # every loss here would add a tunnel round-trip per step to dt;
+            # the earlier losses are floated after the clock stops.
+            last = float(win_losses[-1])
+            fetch_sync(min(jax.tree_util.tree_leaves(params),
+                           key=lambda l: l.size))
+            dt = time.monotonic() - t0
+            losses += [float(l) for l in win_losses[:-1]] + [last]
+            window_rates.append(batch * num_nodes * steps / dt)
+        except Exception as e:
+            # a tunnel death here must not lose a window already measured
+            # (the round-3 session lost a complete 20-step run exactly this
+            # way); the truncated record shows len(window_rates)==1
+            print(f'bench: timing window {len(window_rates) + 1} failed '
+                  f'({type(e).__name__}: {e})', file=sys.stderr)
+            if not window_rates:
+                raise
+            break
 
-    nodes_steps_per_sec = batch * num_nodes * steps / dt
+    nodes_steps_per_sec = max(window_rates)
+    dt = batch * num_nodes * steps / nodes_steps_per_sec
 
     # equivariance L2 error of the trained model (the BASELINE metric's
     # second component). Guarded: this is a SECOND multi-minute compile
@@ -440,6 +473,11 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
         'vs_baseline': round(vs, 3),
         'equivariance_l2': eq_err,
         'step_ms': round(dt / steps * 1e3, 2),
+        'window_rates': [round(r, 2) for r in window_rates],
+        # optimizer steps the loss trajectory spans (2*steps once both
+        # windows complete) — keeps loss_last comparable across rounds
+        # whose window counts differ
+        'steps_trained': len(losses),
     }
     # loss-trajectory sanity: adam at 1e-4 on this objective decreases
     # monotonically-ish from the first step; a flat or garbage sequence

@@ -90,6 +90,9 @@ _HEAVY_TESTS = {
     'test_dataset_feeds_model',
     'test_ring_knn_feeds_model',
     'test_global_feats_dict_input',
+    'test_toy_keeps_frozen_single_window',
+    'test_record_schema',
+    'test_rate_consistent_with_step_ms',
 }
 
 

@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -24,6 +24,10 @@ molecular:         ## edge-conditioned molecular training example
 
 profile:           ## capture an xprof trace of a training step
 	python scripts/profile_model.py --cpu
+
+obs-smoke:         ## 3-step CPU denoise with telemetry: schema-gates the JSONL, renders the report (docs/OBSERVABILITY.md)
+	python denoise.py --steps 3 --nodes 48 --accum 2 --cpu --telemetry --flush-every 2 --metrics /tmp/obs_smoke.jsonl
+	python scripts/obs_report.py /tmp/obs_smoke.jsonl --validate --out /tmp/obs_smoke_summary.json
 
 tpu-checks:        ## on-chip equivariance + kernel numerics/speed gate
 	python scripts/tpu_checks.py

@@ -333,6 +333,14 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
     params, opt_state, loss, _ = exec_fn(params, opt_state, data, key)
     fetch_sync(loss)
 
+    # retrace watchdog (observability.runtime): arm on the warmed-up
+    # trace cache; any post-warmup retrace marks the record — a silent
+    # recompile inside a timed window is exactly the class of artifact
+    # the loss-trajectory check cannot see
+    from se3_transformer_tpu.observability import RetraceWatchdog
+    watchdog = RetraceWatchdog({'train_step': step})
+    watchdog.check()  # first check arms
+
     # keep dispatch async (block only at the end — same timing semantics
     # as before) but RETAIN every step's loss: the 19:29Z session record
     # measured an impossible 411 ms conservative step and the losses
@@ -389,6 +397,19 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
 
     nodes_steps_per_sec = max(window_rates)
     dt = batch * num_nodes * steps / nodes_steps_per_sec
+
+    # post-window watchdog snapshot: retrace count + device memory
+    # (guarded — a diagnostics failure must not lose the timing)
+    retrace_post_warmup = None
+    hbm_peak_bytes = None
+    try:
+        snap = watchdog.check()
+        retrace_post_warmup = len(snap['retraced'])
+        if snap.get('memory'):
+            hbm_peak_bytes = snap['memory'].get('peak_bytes_in_use')
+    except Exception as e:  # noqa: BLE001
+        print(f'watchdog snapshot failed ({type(e).__name__}: {e})',
+              file=sys.stderr)
 
     # equivariance L2 error of the trained model (the BASELINE metric's
     # second component). Guarded: this is a SECOND multi-minute compile
@@ -479,6 +500,12 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
         # whose window counts differ
         'steps_trained': len(losses),
     }
+    if retrace_post_warmup is not None:
+        # 0 on a healthy run; >0 means a window paid a recompile and the
+        # timing is suspect (the watchdog also warned on stderr)
+        record['retrace_post_warmup'] = retrace_post_warmup
+    if hbm_peak_bytes is not None:
+        record['hbm_peak_bytes'] = hbm_peak_bytes
     # loss-trajectory sanity: adam at 1e-4 on this objective decreases
     # monotonically-ish from the first step; a flat or garbage sequence
     # means the executable did not run the program the label claims.

@@ -33,6 +33,15 @@ def main():
     ap.add_argument('--ckpt-every', type=int, default=0,
                     help='also checkpoint every N steps (0 = only at exit)')
     ap.add_argument('--metrics', type=str, default=None)
+    ap.add_argument('--telemetry', action='store_true',
+                    help='first-class telemetry: on-device metric '
+                         'accumulation (no per-step host sync), host '
+                         'phase p50/p95 timing, retrace watchdog, and '
+                         'schema\'d flush/summary JSONL records (pair '
+                         'with --metrics; render via scripts/obs_report)')
+    ap.add_argument('--flush-every', type=int, default=5,
+                    help='telemetry flush interval in optimizer steps '
+                         '(one device-to-host sync per flush)')
     ap.add_argument('--dataset', type=str, default=None,
                     help='train from a PointCloudDataset .npz (see '
                          'training.dataset); --nodes becomes the bucket size')
@@ -48,9 +57,9 @@ def main():
 
     cfg = DenoiseConfig(num_nodes=args.nodes, batch_size=args.batch,
                         num_degrees=args.degrees, use_mesh=args.mesh,
-                        accum_steps=args.accum)
+                        accum_steps=args.accum, telemetry=args.telemetry,
+                        flush_every=args.flush_every)
     trainer = DenoiseTrainer(cfg)
-    logger = MetricLogger(args.metrics)
 
     ckpt = CheckpointManager(args.ckpt_dir) if args.ckpt_dir else None
     if ckpt is not None and ckpt.latest_step() is not None:
@@ -60,54 +69,83 @@ def main():
         trainer.params, trainer.opt_state, trainer.step_count = state
         print(f'resumed from step {trainer.step_count}')
 
-    if args.dataset:
-        import itertools
+    import dataclasses
+    run_meta = dict(tool='denoise', config=dataclasses.asdict(cfg))
+    # context-managed: the file handle closes on EVERY exit path (the old
+    # happy-path-only close() leaked it on exceptions)
+    with MetricLogger(args.metrics, run_meta=run_meta) as logger:
+        if args.dataset:
+            import itertools
 
-        import jax.numpy as jnp
-        import numpy as np
+            import jax.numpy as jnp
+            import numpy as np
 
-        from se3_transformer_tpu.training.dataset import PointCloudDataset
+            from se3_transformer_tpu.training.dataset import (
+                PointCloudDataset,
+            )
 
-        ds = PointCloudDataset.load(args.dataset)
+            ds = PointCloudDataset.load(args.dataset)
 
-        def file_batches():
-            for epoch in itertools.count():
-                yield from ds.batches(batch_size=cfg.batch_size,
-                                      buckets=(cfg.num_nodes,),
-                                      shuffle_seed=epoch)
+            def file_batches():
+                for epoch in itertools.count():
+                    yield from ds.batches(batch_size=cfg.batch_size,
+                                          buckets=(cfg.num_nodes,),
+                                          shuffle_seed=epoch)
 
-        stream = file_batches()
-        history = []
-        for i in range(args.steps):
-            b = next(stream)
-            n = b['tokens'].shape[1]
-            batch = dict(seqs=jnp.asarray(b['tokens']),
-                         coords=jnp.asarray(b['coords']),
-                         masks=jnp.asarray(b['mask']),
-                         adj_mat=jnp.asarray(
-                             np.broadcast_to(b['adj_mat'][None],
-                                             (cfg.batch_size, n, n)).copy()))
-            if cfg.accum_steps > 1:
-                batch = {k: jnp.stack([v] * cfg.accum_steps)
-                         for k, v in batch.items()}
-            loss = trainer.train_step(batch)
-            rec = logger.log(trainer.step_count, loss=float(loss))
-            history.append(rec)
-            if (ckpt is not None and args.ckpt_every > 0
-                    and trainer.step_count % args.ckpt_every == 0):
-                ckpt.save(trainer.step_count,
-                          (trainer.params, trainer.opt_state,
-                           trainer.step_count))
-    else:
-        history = trainer.train(args.steps,
-                                log=lambda msg: logger.log(
-                                    trainer.step_count, msg=msg),
-                                checkpoint_manager=ckpt,
-                                checkpoint_every=args.ckpt_every)
-    if ckpt is not None:
-        ckpt.save(trainer.step_count,
-                  (trainer.params, trainer.opt_state, trainer.step_count))
-        print(f'checkpointed at step {trainer.step_count}')
+            def build_batch(stream):
+                b = next(stream)
+                n = b['tokens'].shape[1]
+                batch = dict(
+                    seqs=jnp.asarray(b['tokens']),
+                    coords=jnp.asarray(b['coords']),
+                    masks=jnp.asarray(b['mask']),
+                    adj_mat=jnp.asarray(
+                        np.broadcast_to(b['adj_mat'][None],
+                                        (cfg.batch_size, n, n)).copy()))
+                if cfg.accum_steps > 1:
+                    batch = {k: jnp.stack([v] * cfg.accum_steps)
+                             for k, v in batch.items()}
+                return batch
+
+            stream = file_batches()
+            history = []
+            for i in range(args.steps):
+                if cfg.telemetry:
+                    with trainer.phase_timer.phase('data'):
+                        batch = build_batch(stream)
+                else:
+                    batch = build_batch(stream)
+                loss = trainer.train_step(batch)
+                if cfg.telemetry:
+                    # no per-step float(): metrics accumulate on device
+                    if (i + 1) % cfg.flush_every == 0:
+                        history.append(trainer.telemetry_flush(logger))
+                else:
+                    history.append(logger.log(trainer.step_count,
+                                              loss=float(loss)))
+                if (ckpt is not None and args.ckpt_every > 0
+                        and trainer.step_count % args.ckpt_every == 0):
+                    import contextlib
+                    with (trainer.phase_timer.phase('checkpoint')
+                          if cfg.telemetry else contextlib.nullcontext()):
+                        ckpt.save(trainer.step_count,
+                                  (trainer.params, trainer.opt_state,
+                                   trainer.step_count))
+            if cfg.telemetry:
+                history.append(trainer.telemetry_close(logger))
+        else:
+            history = trainer.train(args.steps,
+                                    log=lambda msg: logger.log(
+                                        trainer.step_count, msg=msg),
+                                    checkpoint_manager=ckpt,
+                                    checkpoint_every=args.ckpt_every,
+                                    metric_logger=logger
+                                    if cfg.telemetry else None)
+        if ckpt is not None:
+            ckpt.save(trainer.step_count,
+                      (trainer.params, trainer.opt_state,
+                       trainer.step_count))
+            print(f'checkpointed at step {trainer.step_count}')
     return history
 
 

@@ -1,0 +1,83 @@
+"""Render telemetry / bench JSONL streams into one summary JSON.
+
+Usage:
+    python scripts/obs_report.py STREAM.jsonl [MORE.jsonl ...]
+        [--validate] [--out SUMMARY.json] [--anchor FLOAT]
+        [--code-rev REV]
+
+Input species are auto-detected per record:
+  * bench records ({"metric", "value", "unit", ...} — BENCH_SESSION.jsonl,
+    BLOCK_AB.jsonl, BENCH_r0N.json lines): grouped by metric label with
+    best-of-session selection, best single timing window, and one-sided
+    outlier flagging — the machine version of the round-close summary.
+  * telemetry streams (kind=run_meta/step/flush/summary records from a
+    `denoise.py --telemetry` run): reduced to a bench-shaped record
+    (metric/value/unit/vs_baseline/step_ms/loss trajectory) with
+    per-phase p50/p95 and the retrace-warning count.
+
+--validate additionally gates telemetry streams on the record schema
+(observability.schema) and exits non-zero on violation — `make
+obs-smoke` runs exactly that. Never initializes a device backend (no
+jax.devices()/default_backend() call anywhere on this path), so it
+works while the TPU tunnel is wedged.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from se3_transformer_tpu.observability.report import (  # noqa: E402
+    load_jsonl, summarize,
+)
+from se3_transformer_tpu.observability.schema import (  # noqa: E402
+    SchemaError, validate_stream,
+)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='aggregate telemetry/bench JSONL into one summary')
+    ap.add_argument('paths', nargs='+', help='JSONL stream(s)')
+    ap.add_argument('--validate', action='store_true',
+                    help='gate telemetry streams on the record schema '
+                         '(exit 1 on violation)')
+    ap.add_argument('--out', default=None,
+                    help='also write the summary JSON to this path')
+    ap.add_argument('--anchor', type=float, default=None,
+                    help='vs_baseline anchor for telemetry throughput')
+    ap.add_argument('--code-rev', default=None,
+                    help='only summarize bench records with this code_rev')
+    args = ap.parse_args(argv)
+
+    records = []
+    for path in args.paths:
+        recs = load_jsonl(path)
+        if args.validate and any(r.get('kind') == 'run_meta'
+                                 for r in recs):
+            try:
+                info = validate_stream(path)
+            except SchemaError as e:
+                print(f'{path}: SCHEMA VIOLATION: {e}', file=sys.stderr)
+                return 1
+            print(f'{path}: schema ok ({info["records"]} records, '
+                  f'kinds {info["kinds"]})', file=sys.stderr)
+        records += recs
+
+    if not records:
+        print('no records found', file=sys.stderr)
+        return 1
+
+    summary = summarize(records, anchor=args.anchor,
+                        code_rev=args.code_rev)
+    text = json.dumps(summary, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, 'w') as f:
+            f.write(text + '\n')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,8 +1,14 @@
 """Capture a jax.profiler trace of a training step (xprof/perfetto).
 
 Usage: python scripts/profile_model.py [--out /tmp/se3_trace] [--cpu]
-The named_scope labels (neighbors/basis/conv_in/trunk/conv_out) make the
-trace segments directly attributable to model stages.
+The named_scope labels make every hot region of the trace directly
+attributable to a model stage (the authoritative list is
+observability.timing.MODEL_SCOPES):
+
+    neighbors / basis / conv_in / trunk / conv_out   model stages
+    attention / attn_qkv / attn_core                 attention block
+    pallas_attention[_bwd]                           fused attention kernel
+    ring_knn                                         sequence-parallel kNN
 """
 import argparse
 import os

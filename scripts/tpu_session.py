@@ -410,6 +410,34 @@ def main():
         rep = stage_timings.main([])
         log(f'stage_timings: {rep["stage_ms"]}')
 
+    def stage_obs_summary():
+        """Render this session's banked records into the round-close
+        summary shape (observability.report): best-of-session per metric
+        label, outlier flags, best single window — the artifact the
+        round-close process used to hand-assemble from comment blocks.
+        Filtered to the pinned code_rev so stale-build rows stay out."""
+        import json
+        from se3_transformer_tpu.observability.report import (
+            load_jsonl, summarize_bench_records,
+        )
+        root = os.path.dirname(here)
+        recs = []
+        for name in ('BENCH_SESSION.jsonl', 'BLOCK_AB.jsonl'):
+            p = os.path.join(root, name)
+            if os.path.exists(p):
+                recs += load_jsonl(p)
+        rev = os.environ.get('SE3_TPU_CODE_REV')
+        summary = summarize_bench_records(recs, code_rev=rev)
+        if not summary['groups'] and rev:
+            # nothing banked under this rev (e.g. every bench stage died)
+            # — summarize everything rather than write an empty artifact
+            summary = summarize_bench_records(recs)
+        out = os.path.join(root, 'SESSION_SUMMARY.json')
+        with open(out, 'w') as f:
+            json.dump(summary, f, indent=1)
+        log(f'obs_summary: {len(summary["groups"])} metric groups '
+            f'-> {out}')
+
     def stage_profile():
         import numpy as np
         import jax.numpy as jnp
@@ -460,6 +488,8 @@ def main():
         ('timings', 'stage timings (flagship bench config)',
          stage_stage_timings, True),
         ('profile', 'flagship profile', stage_profile, False),
+        ('obs_summary', 'session summary (observability.report)',
+         stage_obs_summary, False),
     ]
     # SE3_TPU_SESSION_STAGES=smoke,bench,bench_fast,baselines runs a
     # focused session (e.g. an A/B after a perf commit) without redoing

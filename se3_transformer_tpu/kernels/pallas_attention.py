@@ -457,9 +457,13 @@ def fused_attention(q, k, v, mask, heads: int, scale: float,
     """Fused multi-degree attention. q [B*h, n, D], k/v [B*kv_h, n, J, D],
     mask [B, n, J] bool or None -> [B*h, n, D] float32. Partitions over
     sharded node / batch-head axes (see the SPMD rules above)."""
-    f = _att_partitioned(heads, scale, interpret, mask is not None, False)
-    args = (q, k, v) + ((mask,) if mask is not None else ())
-    return f(*args)
+    # scope the kernel dispatch so xprof traces attribute it by name
+    # (observability.timing.MODEL_SCOPES)
+    with jax.named_scope('pallas_attention'):
+        f = _att_partitioned(heads, scale, interpret, mask is not None,
+                             False)
+        args = (q, k, v) + ((mask,) if mask is not None else ())
+        return f(*args)
 
 
 def _fa_fwd(q, k, v, mask, heads, scale, interpret):
@@ -469,9 +473,11 @@ def _fa_fwd(q, k, v, mask, heads, scale, interpret):
 
 def _fa_bwd(heads, scale, interpret, res, g):
     q, k, v, mask = res
-    f = _att_partitioned(heads, scale, interpret, mask is not None, True)
-    args = (q, k, v) + ((mask,) if mask is not None else ()) + (g,)
-    dq, dk, dv = f(*args)
+    with jax.named_scope('pallas_attention_bwd'):
+        f = _att_partitioned(heads, scale, interpret, mask is not None,
+                             True)
+        args = (q, k, v) + ((mask,) if mask is not None else ()) + (g,)
+        dq, dk, dv = f(*args)
     return dq, dk, dv, None
 
 

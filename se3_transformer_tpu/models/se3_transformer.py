@@ -41,7 +41,7 @@ from ..ops.rotary import sinusoidal_embeddings
 from ..utils.helpers import (
     batched_index_select, cast_tuple, masked_mean, safe_cat, safe_norm,
 )
-from ..utils.observability import named_scope
+from ..observability import named_scope
 
 Features = Dict[str, jnp.ndarray]
 

@@ -1,0 +1,39 @@
+"""First-class telemetry subsystem.
+
+Supersedes the old `utils/observability.py` single file (which remains as
+a re-export shim). Four pillars:
+
+  * `metrics`  — `MetricAccumulator`, an on-device running-statistics
+    pytree carried through the jitted train step (zero host syncs on hot
+    steps; one device-to-host fetch per flush interval), and the JSONL
+    `MetricLogger` grown with schema'd records (run_id, code_rev,
+    backend, host metadata).
+  * `runtime`  — `RetraceWatchdog`: jit-cache-size / compile-event /
+    device-memory snapshots per flush, with a loud structured warning
+    when a step function retraces after warmup.
+  * `timing`   — `PhaseTimer`: host-side wall-clock reservoirs with
+    windowed p50/p95/max per phase, plus `named_scope` / `profile_trace`
+    for device-side (xprof) attribution of the model phases.
+  * `report`   — aggregate one or more JSONL streams (telemetry runs or
+    banked bench records) into the round-close summary shape: best-of-
+    window selection, outlier flagging, vs_baseline. CLI:
+    `scripts/obs_report.py`.
+
+`schema` holds the record contract both producers and the validator
+share (`make obs-smoke` gates on it).
+"""
+from .metrics import (  # noqa: F401
+    MetricAccumulator, MetricLogger, collect_run_meta, merge_windows,
+)
+from .runtime import (  # noqa: F401
+    RetraceWarning, RetraceWatchdog, device_memory_stats,
+)
+from .timing import (  # noqa: F401
+    MODEL_SCOPES, PhaseTimer, named_scope, profile_trace,
+)
+from .schema import (  # noqa: F401
+    SCHEMA_VERSION, validate_record, validate_stream,
+)
+from .report import (  # noqa: F401
+    load_jsonl, summarize_bench_records, summarize_telemetry,
+)

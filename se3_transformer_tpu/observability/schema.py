@@ -1,0 +1,125 @@
+"""The telemetry-stream record contract (pure Python — no jax import, so
+`scripts/obs_report.py --validate` runs without touching a backend).
+
+A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
+
+  run_meta         stream header: schema_version, backend, code_rev,
+                   host {hostname, pid, python, jax}, device metadata.
+                   MUST be the first record of a stream.
+  step             per-step metrics: step, t, free-form numeric fields.
+  flush            one per flush interval: step, window (per-metric
+                   {count, mean, min, max} from the on-device
+                   accumulator), timing (per-phase {count, p50_ms,
+                   p95_ms, max_ms, mean_ms}), runtime (watchdog
+                   snapshot: cache_sizes, retraced, compile_events,
+                   memory), optional nodes_steps_per_sec.
+  retrace_warning  a step function retraced after warmup (loud copy of
+                   the flush's `retraced` payload).
+  summary          end-of-run cumulative record (metrics, timing,
+                   nodes_steps_per_sec, loss trajectory,
+                   retrace_warnings_total).
+
+`make obs-smoke` gates a 3-step CPU denoise run on `validate_stream`.
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Iterable, Union
+
+SCHEMA_VERSION = 1
+
+KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'summary')
+
+_REQUIRED = {
+    'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
+    'step': ('run_id', 'step', 't'),
+    'flush': ('run_id', 'step', 'window', 'timing', 'runtime'),
+    'retrace_warning': ('run_id', 'retraced'),
+    'summary': ('run_id', 'steps', 'metrics', 'timing'),
+}
+
+_TIMING_REQUIRED = ('count', 'p50_ms', 'p95_ms', 'max_ms')
+_WINDOW_REQUIRED = ('count', 'mean', 'min', 'max')
+
+
+class SchemaError(ValueError):
+    pass
+
+
+def _fail(index, msg):
+    where = f'record {index}: ' if index is not None else ''
+    raise SchemaError(where + msg)
+
+
+def validate_record(rec: dict, index=None) -> dict:
+    """Validate one record; raises SchemaError, returns the record."""
+    if not isinstance(rec, dict):
+        _fail(index, f'not an object: {type(rec).__name__}')
+    kind = rec.get('kind')
+    if kind not in KNOWN_KINDS:
+        _fail(index, f'unknown kind {kind!r} (known: {KNOWN_KINDS})')
+    missing = [k for k in _REQUIRED[kind] if k not in rec]
+    if missing:
+        _fail(index, f'{kind} record missing required fields {missing}')
+    if kind == 'run_meta':
+        host = rec['host']
+        if not isinstance(host, dict) or 'hostname' not in host \
+                or 'pid' not in host:
+            _fail(index, 'run_meta.host must carry hostname and pid')
+    if kind == 'step' and not isinstance(rec['step'], int):
+        _fail(index, f'step must be an int, got {rec["step"]!r}')
+    if kind in ('flush', 'summary'):
+        timing = rec['timing']
+        if not isinstance(timing, dict):
+            _fail(index, 'timing must be an object')
+        for phase, st in timing.items():
+            missing = [k for k in _TIMING_REQUIRED
+                       if not isinstance(st, dict) or k not in st]
+            if missing:
+                _fail(index, f'timing[{phase!r}] missing {missing} '
+                             f'(per-phase p50/p95 are load-bearing)')
+        window = rec.get('window') if kind == 'flush' else rec['metrics']
+        if not isinstance(window, dict):
+            _fail(index, 'metric window must be an object')
+        for name, st in window.items():
+            missing = [k for k in _WINDOW_REQUIRED
+                       if not isinstance(st, dict) or k not in st]
+            if missing:
+                _fail(index, f'window[{name!r}] missing {missing}')
+    return rec
+
+
+def validate_stream(source: Union[str, Iterable[str]]) -> dict:
+    """Validate a JSONL stream (path or iterable of lines).
+
+    Returns {'records': N, 'kinds': {kind: count}, 'run_ids': [...]}.
+    Raises SchemaError on the first invalid record; the first record of
+    a stream must be run_meta (consumers key everything off it).
+    """
+    if isinstance(source, str):
+        with open(source) as f:
+            lines = f.readlines()
+    else:
+        lines = list(source)
+    kinds = Counter()
+    run_ids = []
+    n = 0
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError as e:
+            _fail(i, f'invalid JSON: {e}')
+        validate_record(rec, index=i)
+        if n == 0 and rec['kind'] != 'run_meta':
+            _fail(i, f'stream must open with run_meta, got {rec["kind"]!r}')
+        if rec['kind'] == 'run_meta' and rec['run_id'] not in run_ids:
+            run_ids.append(rec['run_id'])
+        kinds[rec['kind']] += 1
+        n += 1
+    if n == 0:
+        raise SchemaError('empty stream')
+    return dict(records=n, kinds=dict(kinds), run_ids=run_ids)

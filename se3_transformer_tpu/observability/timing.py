@@ -1,0 +1,135 @@
+"""Step/phase wall-clock reservoirs + device-side trace attribution.
+
+Two complementary views of where time goes:
+
+  * `PhaseTimer` — HOST wall clock, percentile reservoirs per phase
+    ('data', 'step', 'checkpoint', ...). In a steady async-dispatch
+    pipeline the host loop converges onto device step time via queue
+    backpressure, so windowed p50/p95/max of the 'step' phase tracks
+    real step time without forcing a per-step sync.
+  * `named_scope` / `profile_trace` — DEVICE attribution: scopes label
+    the HLO so xprof/perfetto traces name every hot region. The model
+    scopes in `MODEL_SCOPES` are kept in sync with the code
+    (models/se3_transformer.py, ops/attention.py,
+    kernels/pallas_attention.py, parallel/ring.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Dict, Optional
+
+import jax
+
+# every named_scope label the model emits, for trace readers
+# (scripts/profile_model.py docstring mirrors this list)
+MODEL_SCOPES = (
+    'neighbors',          # models/se3_transformer.py — kNN selection
+    'basis',              # models/se3_transformer.py — SH basis
+    'conv_in',            # models/se3_transformer.py
+    'trunk',              # models/se3_transformer.py
+    'conv_out',           # models/se3_transformer.py
+    'attention',          # ops/attention.py — whole attention call
+    'attn_qkv',           # ops/attention.py — q/k/v projections+convs
+    'attn_core',          # ops/attention.py — sim/softmax/weighted sum
+    'pallas_attention',   # kernels/pallas_attention.py — fused kernel
+    'ring_knn',           # parallel/ring.py — sequence-parallel kNN
+)
+
+
+def named_scope(name: str):
+    """Label a region for profilers; no-op cost under jit."""
+    return jax.named_scope(name)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str, enabled: bool = True):
+    """Capture a jax.profiler trace (tensorboard/perfetto-compatible)."""
+    if not enabled:
+        yield
+        return
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def _percentiles(samples) -> dict:
+    import numpy as np
+    a = np.asarray(samples, dtype=float) * 1e3  # -> ms
+    return dict(count=int(a.size),
+                p50_ms=round(float(np.percentile(a, 50)), 3),
+                p95_ms=round(float(np.percentile(a, 95)), 3),
+                max_ms=round(float(a.max()), 3),
+                mean_ms=round(float(a.mean()), 3))
+
+
+class PhaseTimer:
+    """Host wall-clock reservoirs per phase with windowed percentiles.
+
+        timer = PhaseTimer()
+        with timer.phase('step'):
+            ...dispatch the train step...
+        stats = timer.window_summary()   # {phase: {p50_ms, p95_ms, ...}}
+
+    `window_summary` reports and resets the current window (call it at
+    the flush interval); `cumulative_summary` covers the whole run (its
+    reservoir is capped at `capacity` samples — count/sum/max stay
+    exact beyond that, percentiles come from the first `capacity`).
+    """
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._window: Dict[str, list] = {}
+        self._all: Dict[str, list] = {}
+        self._totals: Dict[str, dict] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, time.perf_counter() - t0)
+
+    def record(self, name: str, seconds: float):
+        self._window.setdefault(name, []).append(seconds)
+        full = self._all.setdefault(name, [])
+        if len(full) < self.capacity:
+            full.append(seconds)
+        tot = self._totals.setdefault(
+            name, dict(count=0, total_s=0.0, max_s=0.0))
+        tot['count'] += 1
+        tot['total_s'] += seconds
+        tot['max_s'] = max(tot['max_s'], seconds)
+
+    def window_summary(self, reset: bool = True) -> dict:
+        out = {name: _percentiles(samples)
+               for name, samples in self._window.items() if samples}
+        if reset:
+            self._window = {}
+        return out
+
+    def cumulative_summary(self) -> dict:
+        out = {}
+        for name, samples in self._all.items():
+            if not samples:
+                continue
+            stats = _percentiles(samples)
+            tot = self._totals[name]
+            stats.update(count=tot['count'],
+                         total_s=round(tot['total_s'], 4),
+                         max_ms=round(tot['max_s'] * 1e3, 3))
+            out[name] = stats
+        return out
+
+    def total_seconds(self, name: str) -> float:
+        tot = self._totals.get(name)
+        return tot['total_s'] if tot else 0.0
+
+    def total_count(self, name: str) -> int:
+        tot = self._totals.get(name)
+        return tot['count'] if tot else 0

@@ -19,6 +19,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..observability import named_scope
 from ..utils.helpers import batched_index_select, to_order
 from .conv import ConvSE3, EdgeInfo
 from .core import LinearSE3, NormSE3, residual_se3
@@ -85,31 +86,40 @@ class AttentionSE3(nn.Module):
             conv_bf16=self.conv_bf16,
             pallas_interpret=self.pallas_interpret)
 
-        queries = LinearSE3(self.fiber, hidden_fiber, name='to_q')(features)
-        values = ConvSE3(self.fiber, kv_fiber, name='to_v', **conv_kwargs)(
-            features, edge_info, rel_dist, basis)
-
-        if self.linear_proj_keys:
-            keys = LinearSE3(self.fiber, kv_fiber, name='to_k')(features)
-            keys = {d: batched_index_select(v, neighbor_indices, axis=1)
-                    for d, v in keys.items()}
-        elif self.tie_key_values:
-            keys = values
-        else:
-            keys = ConvSE3(self.fiber, kv_fiber, name='to_k', **conv_kwargs)(
+        # named scopes ('attn_qkv' projections, 'attn_core' per-degree
+        # sim/softmax/sum) keep xprof traces attributable; the whole call
+        # additionally sits under the block's 'attention' scope
+        with named_scope('attn_qkv'):
+            queries = LinearSE3(self.fiber, hidden_fiber,
+                                name='to_q')(features)
+            values = ConvSE3(self.fiber, kv_fiber, name='to_v',
+                             **conv_kwargs)(
                 features, edge_info, rel_dist, basis)
 
-        if self.attend_self:
-            self_keys = LinearSE3(self.fiber, kv_fiber,
-                                  name='to_self_k')(features)
-            self_values = LinearSE3(self.fiber, kv_fiber,
-                                    name='to_self_v')(features)
+            if self.linear_proj_keys:
+                keys = LinearSE3(self.fiber, kv_fiber, name='to_k')(features)
+                keys = {d: batched_index_select(v, neighbor_indices, axis=1)
+                        for d, v in keys.items()}
+            elif self.tie_key_values:
+                keys = values
+            else:
+                keys = ConvSE3(self.fiber, kv_fiber, name='to_k',
+                               **conv_kwargs)(
+                    features, edge_info, rel_dist, basis)
 
-        if global_feats is not None:
-            g_in = Fiber.create(1, self.global_feats_dim)
-            g_out = Fiber.create(1, self.dim_head * kv_h)
-            global_keys = LinearSE3(g_in, g_out, name='to_global_k')(global_feats)
-            global_values = LinearSE3(g_in, g_out, name='to_global_v')(global_feats)
+            if self.attend_self:
+                self_keys = LinearSE3(self.fiber, kv_fiber,
+                                      name='to_self_k')(features)
+                self_values = LinearSE3(self.fiber, kv_fiber,
+                                        name='to_self_v')(features)
+
+            if global_feats is not None:
+                g_in = Fiber.create(1, self.global_feats_dim)
+                g_out = Fiber.create(1, self.dim_head * kv_h)
+                global_keys = LinearSE3(g_in, g_out,
+                                        name='to_global_k')(global_feats)
+                global_values = LinearSE3(g_in, g_out,
+                                          name='to_global_v')(global_feats)
 
         outputs = {}
         for degree in features.keys():
@@ -206,18 +216,20 @@ class AttentionSE3(nn.Module):
                                       self.pallas_attention_interpret)
                 out = out.reshape(b, h, n, self.dim_head, m)
             else:
-                if one_headed:
-                    sim = jnp.einsum('bhidm,bijdm->bhij', q, k[:, 0]) * scale
-                else:
-                    sim = jnp.einsum('bhidm,bhijdm->bhij', q, k) * scale
-                if padded_mask is not None:
-                    sim = jnp.where(padded_mask[:, None], sim,
-                                    jnp.finfo(sim.dtype).min)
-                attn = nn.softmax(sim, axis=-1)
-                if one_headed:
-                    out = jnp.einsum('bhij,bijdm->bhidm', attn, v[:, 0])
-                else:
-                    out = jnp.einsum('bhij,bhijdm->bhidm', attn, v)
+                with named_scope('attn_core'):
+                    if one_headed:
+                        sim = jnp.einsum('bhidm,bijdm->bhij',
+                                         q, k[:, 0]) * scale
+                    else:
+                        sim = jnp.einsum('bhidm,bhijdm->bhij', q, k) * scale
+                    if padded_mask is not None:
+                        sim = jnp.where(padded_mask[:, None], sim,
+                                        jnp.finfo(sim.dtype).min)
+                    attn = nn.softmax(sim, axis=-1)
+                    if one_headed:
+                        out = jnp.einsum('bhij,bijdm->bhidm', attn, v[:, 0])
+                    else:
+                        out = jnp.einsum('bhij,bhijdm->bhidm', attn, v)
             outputs[degree] = out.transpose(0, 2, 1, 3, 4).reshape(
                 b, n, h * self.dim_head, m)
 
@@ -266,25 +278,27 @@ class AttentionBlockSE3(nn.Module):
         res = features
         out = NormSE3(self.fiber, gated_scale=self.norm_gated_scale,
                       name='prenorm')(features)
-        out = AttentionSE3(
-            self.fiber, heads=self.heads, dim_head=self.dim_head,
-            kv_heads=1 if self.one_headed_key_values else None,
-            attend_self=self.attend_self, edge_dim=self.edge_dim,
-            use_null_kv=self.use_null_kv,
-            fourier_encode_dist=self.fourier_encode_dist,
-            rel_dist_num_fourier_features=self.rel_dist_num_fourier_features,
-            global_feats_dim=self.global_feats_dim,
-            linear_proj_keys=self.linear_proj_keys,
-            tie_key_values=self.tie_key_values,
-            pallas=self.pallas,
-            pallas_attention=self.pallas_attention,
-            pallas_attention_interpret=self.pallas_attention_interpret,
-            shared_radial_hidden=self.shared_radial_hidden,
-            edge_chunks=self.edge_chunks,
-            fuse_basis=self.fuse_basis,
-            radial_bf16=self.radial_bf16,
-            conv_bf16=self.conv_bf16,
-            pallas_interpret=self.pallas_interpret,
-            name='attn')(out, edge_info, rel_dist, basis, global_feats,
-                         pos_emb, mask)
+        with named_scope('attention'):
+            out = AttentionSE3(
+                self.fiber, heads=self.heads, dim_head=self.dim_head,
+                kv_heads=1 if self.one_headed_key_values else None,
+                attend_self=self.attend_self, edge_dim=self.edge_dim,
+                use_null_kv=self.use_null_kv,
+                fourier_encode_dist=self.fourier_encode_dist,
+                rel_dist_num_fourier_features=(
+                    self.rel_dist_num_fourier_features),
+                global_feats_dim=self.global_feats_dim,
+                linear_proj_keys=self.linear_proj_keys,
+                tie_key_values=self.tie_key_values,
+                pallas=self.pallas,
+                pallas_attention=self.pallas_attention,
+                pallas_attention_interpret=self.pallas_attention_interpret,
+                shared_radial_hidden=self.shared_radial_hidden,
+                edge_chunks=self.edge_chunks,
+                fuse_basis=self.fuse_basis,
+                radial_bf16=self.radial_bf16,
+                conv_bf16=self.conv_bf16,
+                pallas_interpret=self.pallas_interpret,
+                name='attn')(out, edge_info, rel_dist, basis, global_feats,
+                             pos_emb, mask)
         return residual_se3(out, res)

@@ -174,7 +174,10 @@ def ring_knn(coors: jnp.ndarray, k: int, mesh: Mesh,
 
     fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
                        out_specs=(spec, spec))
-    return fn(*args)
+    # scope the ring (scan of score/merge/ppermute) for xprof attribution
+    # (observability.timing.MODEL_SCOPES)
+    with jax.named_scope('ring_knn'):
+        return fn(*args)
 
 
 def dense_knn(coors: jnp.ndarray, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:

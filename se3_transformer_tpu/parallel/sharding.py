@@ -100,7 +100,8 @@ def shard_params(params, mesh: Mesh, axis: str = 'tp'):
 def make_sharded_train_step(loss_fn: Callable, optimizer,
                             mesh: Optional[Mesh] = None,
                             donate: bool = True,
-                            tensor_parallel: bool = False):
+                            tensor_parallel: bool = False,
+                            telemetry: bool = False):
     """loss_fn(params, batch, rng) -> (loss, aux). Returns
     step(params, opt_state, batch, rng) -> (params, opt_state, loss, aux),
     jitted; when `mesh` is given, the caller is expected to place `batch`
@@ -109,6 +110,13 @@ def make_sharded_train_step(loss_fn: Callable, optimizer,
     the caller gave them (see `shard_params`), so tp-partitioned weights
     stay partitioned through the update and GSPMD inserts the psum for
     the row-parallel contractions.
+
+    With `telemetry=True` the step signature grows by exactly one
+    argument/result — an `observability.MetricAccumulator` pytree that
+    folds loss and global grad norm ON DEVICE (a handful of scalar ops,
+    no host sync): step(params, opt_state, batch, rng, acc) ->
+    (params, opt_state, loss, aux, acc). The host flushes the
+    accumulator once per logging interval.
     """
 
     def step(params, opt_state, batch, rng):
@@ -118,34 +126,51 @@ def make_sharded_train_step(loss_fn: Callable, optimizer,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss, aux
 
-    donate_argnums = (0, 1) if donate else ()
+    def step_telemetry(params, opt_state, batch, rng, acc):
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch, rng)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        acc = acc.update(loss=loss, grad_norm=optax.global_norm(grads))
+        return params, opt_state, loss, aux, acc
+
+    fn = step_telemetry if telemetry else step
+    # the accumulator is replaced every step — donate it like the state
+    donate_argnums = ((0, 1, 4) if telemetry else (0, 1)) if donate else ()
     if mesh is None:
-        return jax.jit(step, donate_argnums=donate_argnums)
+        return jax.jit(fn, donate_argnums=donate_argnums)
 
     repl = replicated(mesh)
+    acc_in = (repl,) if telemetry else ()
+    acc_out = (repl,) if telemetry else ()
     if tensor_parallel:
         # None = follow the argument/result placement (params arrive
         # pre-sharded by shard_params; donation keeps buffers in place)
-        return jax.jit(step, in_shardings=(None, None, None, repl),
-                       out_shardings=(None, None, repl, repl),
+        return jax.jit(fn, in_shardings=(None, None, None, repl) + acc_in,
+                       out_shardings=(None, None, repl, repl) + acc_out,
                        donate_argnums=donate_argnums)
     return jax.jit(
-        step,
-        in_shardings=(repl, repl, None, repl),
-        out_shardings=(repl, repl, repl, repl),
+        fn,
+        in_shardings=(repl, repl, None, repl) + acc_in,
+        out_shardings=(repl, repl, repl, repl) + acc_out,
         donate_argnums=donate_argnums)
 
 
 def make_accumulating_train_step(loss_fn: Callable, optimizer,
                                  accum_steps: int,
                                  mesh: Optional[Mesh] = None,
-                                 tensor_parallel: bool = False):
+                                 tensor_parallel: bool = False,
+                                 telemetry: bool = False):
     """Gradient-accumulation variant (reference denoise.py:13,55 uses 16
     micro-steps). batch leaves must have a leading [accum_steps, ...] axis;
     micro-batches are consumed with lax.scan so the compiled program is
-    O(1) in accum_steps."""
+    O(1) in accum_steps.
 
-    def step(params, opt_state, batch, rng):
+    `telemetry=True` threads a MetricAccumulator exactly like
+    make_sharded_train_step; the per-micro-step loss VECTOR folds in, so
+    the flushed window's loss min/max expose a diverging micro-batch."""
+
+    def _grads_and_losses(params, batch, rng):
         def micro(carry, xs):
             acc, rng = carry
             micro_batch, = xs
@@ -157,7 +182,11 @@ def make_accumulating_train_step(loss_fn: Callable, optimizer,
 
         zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
         (grads, _), losses = jax.lax.scan(micro, (zeros, rng), (batch,))
-        grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
+        return jax.tree_util.tree_map(lambda g: g / accum_steps,
+                                      grads), losses
+
+    def step(params, opt_state, batch, rng):
+        grads, losses = _grads_and_losses(params, batch, rng)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         # per-micro-step losses ride along (the reference prints every
@@ -165,13 +194,23 @@ def make_accumulating_train_step(loss_fn: Callable, optimizer,
         # diverging micro-batch); same 4-arity as make_sharded_train_step
         return params, opt_state, losses.mean(), losses
 
+    def step_telemetry(params, opt_state, batch, rng, acc):
+        grads, losses = _grads_and_losses(params, batch, rng)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        acc = acc.update(loss=losses, grad_norm=optax.global_norm(grads))
+        return params, opt_state, losses.mean(), losses, acc
+
+    fn = step_telemetry if telemetry else step
+    donate_argnums = (0, 1, 4) if telemetry else (0, 1)
     if mesh is None:
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(fn, donate_argnums=donate_argnums)
     repl = replicated(mesh)
+    acc_s = (repl,) if telemetry else ()
     if tensor_parallel:
-        return jax.jit(step, in_shardings=(None, None, None, repl),
-                       out_shardings=(None, None, repl, repl),
-                       donate_argnums=(0, 1))
-    return jax.jit(step, in_shardings=(repl, repl, None, repl),
-                   out_shardings=(repl, repl, repl, repl),
-                   donate_argnums=(0, 1))
+        return jax.jit(fn, in_shardings=(None, None, None, repl) + acc_s,
+                       out_shardings=(None, None, repl, repl) + acc_s,
+                       donate_argnums=donate_argnums)
+    return jax.jit(fn, in_shardings=(repl, repl, None, repl) + acc_s,
+                   out_shardings=(repl, repl, repl, repl) + acc_s,
+                   donate_argnums=donate_argnums)

@@ -18,6 +18,7 @@ and gradient accumulation. Differences by design:
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 
@@ -65,6 +66,12 @@ class DenoiseConfig:
     # parallel.sharding.param_partition_specs); requires a mesh with tp>1
     tensor_parallel: bool = False
     log_every: int = 1
+    # first-class telemetry (observability package): thread an on-device
+    # MetricAccumulator through the jitted step (zero host syncs on hot
+    # steps), time host phases, watch for post-warmup retraces, and
+    # flush one schema'd record every flush_every steps
+    telemetry: bool = False
+    flush_every: int = 10
 
     def build_module(self) -> SE3TransformerModule:
         return SE3TransformerModule(
@@ -143,17 +150,38 @@ class DenoiseTrainer:
             # reference denoise.py:13,55: 16 micro-batches per update
             self._step_fn = make_accumulating_train_step(
                 self.loss_fn, self.optimizer, cfg.accum_steps,
-                mesh=self.mesh, tensor_parallel=self.tensor_parallel)
+                mesh=self.mesh, tensor_parallel=self.tensor_parallel,
+                telemetry=cfg.telemetry)
         else:
             self._step_fn = make_sharded_train_step(
                 self.loss_fn, self.optimizer, mesh=self.mesh,
-                tensor_parallel=self.tensor_parallel)
+                tensor_parallel=self.tensor_parallel,
+                telemetry=cfg.telemetry)
         self.np_rng = np.random.RandomState(cfg.seed)
         self.rng = jax.random.PRNGKey(cfg.seed)
         self.params = None
         self.opt_state = None
         self.step_count = 0
         self.last_micro_losses = None
+        self.metric_acc = None
+        self.phase_timer = None
+        self.watchdog = None
+        if cfg.telemetry:
+            from ..observability import (
+                MetricAccumulator, PhaseTimer, RetraceWatchdog,
+            )
+            self.metric_acc = MetricAccumulator.zero(('loss', 'grad_norm'))
+            self.phase_timer = PhaseTimer()
+            self.watchdog = RetraceWatchdog({'train_step': self._step_fn})
+            self._cum_metrics = None     # host-side merge of windows
+            self._flush_count = 0
+            self._last_flush_step = 0
+            self._first_loss = None      # device refs: synced at close
+            self._last_loss = None
+            # compile happens on the first step of THIS process, not of
+            # the run — a checkpoint-resumed trainer has step_count > 0
+            # but still pays the compile on its first dispatch
+            self._warmed_up = False
 
     def init(self, batch=None):
         batch = batch if batch is not None else synthetic_protein_batch(
@@ -192,8 +220,25 @@ class DenoiseTrainer:
             batch = dict(seqs=batch['feats'], coords=batch['coors'],
                          masks=batch['mask'], adj_mat=batch['adj_mat'])
         self.rng, sub = jax.random.split(self.rng)
-        self.params, self.opt_state, loss, aux = self._step_fn(
-            self.params, self.opt_state, batch, sub)
+        if self.cfg.telemetry:
+            # the step signature differs only by the accumulator pytree;
+            # 'step' wall clock is dispatch-to-dispatch — no forced sync.
+            # The first dispatch of this process carries the XLA
+            # compile: bill it to 'warmup' so step percentiles and
+            # throughput stay honest (also on checkpoint resume)
+            phase = 'step' if self._warmed_up else 'warmup'
+            self._warmed_up = True
+            with self.phase_timer.phase(phase):
+                (self.params, self.opt_state, loss, aux,
+                 self.metric_acc) = self._step_fn(
+                    self.params, self.opt_state, batch, sub,
+                    self.metric_acc)
+            if self._first_loss is None:
+                self._first_loss = loss   # device ref; float()ed at close
+            self._last_loss = loss
+        else:
+            self.params, self.opt_state, loss, aux = self._step_fn(
+                self.params, self.opt_state, batch, sub)
         # with accum_steps > 1 the aux slot carries the per-micro-step
         # losses (VERDICT r2 weak #6: the mean alone hides a diverging
         # micro-batch; the reference prints every step, denoise.py:91)
@@ -210,24 +255,113 @@ class DenoiseTrainer:
         return jax.tree_util.tree_map(
             lambda *vs: jnp.stack(vs), *batches)
 
+    # ------------------------------------------------------------------ #
+    # telemetry (observability package): flush cadence owned by the host
+    # ------------------------------------------------------------------ #
+    def _telemetry_label(self) -> str:
+        c = self.cfg
+        return (f'denoise,dim={c.dim},depth={c.depth},n={c.num_nodes},'
+                f'deg={c.num_degrees},accum={max(1, c.accum_steps)}')
+
+    def _nodes_per_step(self) -> int:
+        return (self.cfg.batch_size * self.cfg.num_nodes
+                * max(1, self.cfg.accum_steps))
+
+    def telemetry_flush(self, metric_logger=None):
+        """Flush the window: ONE device-to-host sync (the accumulator
+        fetch), host-phase percentiles, and the retrace/memory snapshot,
+        as one schema'd `flush` record. Returns the record fields."""
+        assert self.cfg.telemetry, 'telemetry_flush requires cfg.telemetry'
+        from ..observability.metrics import merge_windows
+        window, self.metric_acc = self.metric_acc.flush()
+        timing = self.phase_timer.window_summary()
+        runtime = self.watchdog.check()
+        self._cum_metrics = merge_windows(self._cum_metrics, window)
+        self._flush_count += 1
+        fields = dict(step=self.step_count, window=window, timing=timing,
+                      runtime=runtime)
+        self._last_flush_step = self.step_count
+        step_t = timing.get('step')
+        if step_t and step_t['mean_ms'] > 0:
+            # rate over the steps this window actually timed (the warmup
+            # step is billed to its own phase and excluded)
+            fields['nodes_steps_per_sec'] = round(
+                self._nodes_per_step() / (step_t['mean_ms'] / 1e3), 2)
+        if runtime['retraced'] and metric_logger is not None:
+            metric_logger.log_record('retrace_warning',
+                                     step=self.step_count,
+                                     retraced=runtime['retraced'])
+        if metric_logger is not None:
+            return metric_logger.log_record('flush', **fields)
+        return fields
+
+    def telemetry_close(self, metric_logger=None):
+        """Final flush (residual window) + the cumulative `summary`
+        record: run-wide per-phase percentiles, merged metric stats,
+        throughput, loss trajectory, total retrace warnings."""
+        assert self.cfg.telemetry, 'telemetry_close requires cfg.telemetry'
+        if self.step_count > self._last_flush_step:
+            self.telemetry_flush(metric_logger)
+        timing = self.phase_timer.cumulative_summary()
+        total_step_s = self.phase_timer.total_seconds('step')
+        steps = self.phase_timer.total_count('step')
+        fields = dict(
+            steps=self.step_count,
+            label=self._telemetry_label(),
+            metrics=self._cum_metrics or {},
+            timing=timing,
+            retrace_warnings_total=self.watchdog.warnings_total,
+        )
+        if steps and total_step_s > 0:
+            fields['nodes_steps_per_sec'] = round(
+                self._nodes_per_step() * steps / total_step_s, 2)
+        if self._first_loss is not None:
+            # the only other host syncs of the run: two scalars, at close
+            first = float(jnp.asarray(self._first_loss).mean())
+            last = float(jnp.asarray(self._last_loss).mean())
+            fields.update(loss_first=round(first, 4),
+                          loss_last=round(last, 4),
+                          loss_decreased=bool(last < first)
+                          and bool(np.isfinite(first))
+                          and bool(np.isfinite(last)))
+        if metric_logger is not None:
+            return metric_logger.log_record('summary', **fields)
+        return fields
+
     def train(self, num_steps: int, log=print, checkpoint_manager=None,
-              checkpoint_every: int = 0):
+              checkpoint_every: int = 0, metric_logger=None):
         """Reference denoise.py:54-93 outer loop, with structured metrics.
 
         With a CheckpointManager and checkpoint_every > 0, state is saved
         periodically — the preemption-recovery story for TPU slices (the
-        CLI additionally saves at exit and resumes at start)."""
+        CLI additionally saves at exit and resumes at start).
+
+        With cfg.telemetry, the per-step float(loss) sync disappears:
+        metrics accumulate on device and flush (through `metric_logger`
+        when given) every cfg.flush_every steps plus once at the end —
+        history then holds the flush/summary records."""
         history = []
         t0 = time.time()
         micro = max(1, self.cfg.accum_steps)
+        telemetry = self.cfg.telemetry
         for i in range(num_steps):
-            batch = self.micro_batches()
+            if telemetry:
+                with self.phase_timer.phase('data'):
+                    batch = self.micro_batches()
+            else:
+                batch = self.micro_batches()
             loss = self.train_step(batch)
             if (checkpoint_manager is not None and checkpoint_every > 0
                     and self.step_count % checkpoint_every == 0):
-                checkpoint_manager.save(
-                    self.step_count,
-                    (self.params, self.opt_state, self.step_count))
+                with (self.phase_timer.phase('checkpoint') if telemetry
+                      else contextlib.nullcontext()):
+                    checkpoint_manager.save(
+                        self.step_count,
+                        (self.params, self.opt_state, self.step_count))
+            if telemetry:
+                if (i + 1) % self.cfg.flush_every == 0:
+                    history.append(self.telemetry_flush(metric_logger))
+                continue
             if (i + 1) % self.cfg.log_every == 0:
                 loss = float(loss)  # host sync only at log interval
                 dt = time.time() - t0
@@ -246,4 +380,6 @@ class DenoiseTrainer:
                 history.append(rec)
                 log(f'step {self.step_count} loss {loss:.4f} '
                     f'nodes*steps/sec {nodes_per_sec:.1f}{extra}')
+        if telemetry:
+            history.append(self.telemetry_close(metric_logger))
         return history

@@ -1,67 +1,17 @@
-"""Tracing, profiling and structured metrics.
-
-The reference's observability is a wall-clock decorator and print
-statements (SURVEY.md §5). TPU-native equivalents:
-
-  * named_scope context managers around basis/conv/attention so XLA/HLO
-    profiles and perfetto traces are readable,
-  * jax.profiler trace capture to a directory (view with xprof/perfetto),
-  * a MetricLogger that emits structured JSONL without forcing a host
-    sync except at the logging interval.
+"""Back-compat shim: the observability implementation moved to the
+`se3_transformer_tpu.observability` package (metrics / runtime / timing /
+schema / report). Import from there in new code; this module keeps every
+pre-existing import site (`from ..utils.observability import ...`)
+working unchanged.
 """
-from __future__ import annotations
-
-import contextlib
-import json
-import os
-import time
-from typing import Optional
-
-import jax
-
-
-def named_scope(name: str):
-    """Label a region for profilers; no-op cost under jit."""
-    return jax.named_scope(name)
-
-
-@contextlib.contextmanager
-def profile_trace(log_dir: str, enabled: bool = True):
-    """Capture a jax.profiler trace (tensorboard/perfetto-compatible)."""
-    if not enabled:
-        yield
-        return
-    os.makedirs(log_dir, exist_ok=True)
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-class MetricLogger:
-    """Structured JSONL metric stream + stdout mirror."""
-
-    def __init__(self, path: Optional[str] = None, mirror=print):
-        self.path = path
-        self.mirror = mirror
-        if path:
-            os.makedirs(os.path.dirname(os.path.abspath(path)),
-                        exist_ok=True)
-        self._fh = open(path, 'a') if path else None
-        self._t0 = time.time()
-
-    def log(self, step: int, **metrics):
-        rec = dict(step=step, t=round(time.time() - self._t0, 3))
-        rec.update({k: (float(v) if hasattr(v, 'item') else v)
-                    for k, v in metrics.items()})
-        if self._fh:
-            self._fh.write(json.dumps(rec) + '\n')
-            self._fh.flush()
-        if self.mirror:
-            self.mirror(' '.join(f'{k}={v}' for k, v in rec.items()))
-        return rec
-
-    def close(self):
-        if self._fh:
-            self._fh.close()
+from ..observability import (  # noqa: F401
+    MetricAccumulator,
+    MetricLogger,
+    PhaseTimer,
+    RetraceWarning,
+    RetraceWatchdog,
+    collect_run_meta,
+    device_memory_stats,
+    named_scope,
+    profile_trace,
+)

@@ -99,8 +99,10 @@ def test_metric_logger(tmp_path):
     logger.log(2, loss=0.25)
     logger.close()
     recs = [json.loads(l) for l in open(path)]
-    assert recs[0]['step'] == 1 and abs(recs[0]['grad_norm'] - 2.0) < 1e-9
-    assert recs[1]['loss'] == 0.25
+    # streams open with the schema'd run_meta header (observability)
+    assert recs[0]['kind'] == 'run_meta' and recs[0]['backend'] == 'cpu'
+    assert recs[1]['step'] == 1 and abs(recs[1]['grad_norm'] - 2.0) < 1e-9
+    assert recs[2]['loss'] == 0.25
 
 
 def test_background_batcher_and_prefetch():

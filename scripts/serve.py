@@ -1,0 +1,202 @@
+"""Serve a mixed-length request stream through the inference subsystem.
+
+Usage:
+    python scripts/serve.py [--requests N] [--oversize K]
+        [--buckets 12,24] [--batch-size 2] [--max-wait-ms 5]
+        [--max-queue-depth 64] [--bf16] [--checkpoint DIR] [--cpu]
+        [--metrics SERVE.jsonl] [--out SUMMARY.json] [--seed S]
+
+Startup: restore params (params-only — optimizer state never
+materializes) or init a toy model, AOT-compile one executable per
+bucket, arm the compile-event watchdog. Serve loop: admit -> enqueue ->
+micro-batch (flush on full or deadline) -> answer. Close: a
+SESSION_SUMMARY-style report.
+
+This doubles as the `make serve-smoke` gate, exiting non-zero when
+  * the telemetry stream fails schema validation, or
+  * any post-warmup compile event fired (the AOT contract: a
+    mixed-length stream over precompiled buckets must compile NOTHING),
+  * or an in-range request failed to produce a result.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from se3_transformer_tpu.utils.compilation_cache import (  # noqa: E402
+    enable_compilation_cache,
+)
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description='bucketed AOT serving over a mixed-length stream')
+    ap.add_argument('--requests', type=int, default=8,
+                    help='in-range requests, lengths cycling across '
+                         'buckets (mixed-length by construction)')
+    ap.add_argument('--oversize', type=int, default=1,
+                    help='extra requests longer than the largest bucket '
+                         '(must be rejected, never compiled)')
+    ap.add_argument('--buckets', type=str, default='12,24')
+    ap.add_argument('--batch-size', type=int, default=2)
+    ap.add_argument('--max-wait-ms', type=float, default=5.0)
+    ap.add_argument('--max-queue-depth', type=int, default=64)
+    ap.add_argument('--flush-every', type=int, default=2,
+                    help='emit a serve record every N dispatched batches')
+    ap.add_argument('--bf16', action='store_true',
+                    help='bf16 activation path (coords cast in, f32 out)')
+    ap.add_argument('--checkpoint', type=str, default=None,
+                    help='CheckpointManager directory; params-only '
+                         'restore (optimizer state is never read)')
+    ap.add_argument('--metrics', type=str, default=None,
+                    help='JSONL telemetry stream (serve records)')
+    ap.add_argument('--out', type=str, default=None,
+                    help='write the summary report JSON here')
+    ap.add_argument('--seed', type=int, default=0)
+    ap.add_argument('--cpu', action='store_true',
+                    help='force the CPU backend')
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax
+    if args.cpu:
+        jax.config.update('jax_platforms', 'cpu')
+    enable_compilation_cache()
+    import numpy as np
+
+    from se3_transformer_tpu.inference import (
+        AdmissionController, InferenceEngine, MicroBatcher,
+        RequestRejected, ServeTelemetry,
+    )
+    from se3_transformer_tpu.native.loader import chain_adjacency
+    from se3_transformer_tpu.observability import MetricLogger
+    from se3_transformer_tpu.observability.schema import (
+        SchemaError, validate_stream,
+    )
+    from se3_transformer_tpu.training.denoise import DenoiseConfig
+    import jax.numpy as jnp
+
+    buckets = tuple(int(b) for b in args.buckets.split(','))
+    cfg = DenoiseConfig(num_tokens=24, dim=8, dim_head=8, heads=2, depth=2,
+                        num_degrees=2, max_sparse_neighbors=4)
+    module = cfg.build_module()
+
+    rng = np.random.RandomState(args.seed)
+    if args.checkpoint:
+        from se3_transformer_tpu.training.checkpoint import CheckpointManager
+        params = CheckpointManager(args.checkpoint).restore_params()
+        print(f'restored params-only from {args.checkpoint}')
+    else:
+        L = buckets[0]
+        params = module.init(
+            jax.random.PRNGKey(args.seed),
+            jnp.asarray(rng.randint(0, cfg.num_tokens, size=(1, L))),
+            jnp.asarray(rng.normal(size=(1, L, 3)).astype(np.float32)),
+            mask=jnp.ones((1, L), bool),
+            adj_mat=jnp.asarray(chain_adjacency(L)),
+            return_type=1)['params']
+        print('no --checkpoint: initialized fresh (seeded) params')
+
+    # ---- startup: AOT-compile every bucket, then arm the watchdog ---- #
+    t0 = time.perf_counter()
+    engine = InferenceEngine(
+        module, params, buckets=buckets, batch_size=args.batch_size,
+        return_type=1,
+        activation_dtype=jnp.bfloat16 if args.bf16 else None)
+    print(f'warmup: compiled {len(engine.executables)} bucket '
+          f'executables in {time.perf_counter() - t0:.1f}s '
+          f'({engine.compile_seconds})')
+
+    admission = AdmissionController(max_len=engine.max_len,
+                                    max_queue_depth=args.max_queue_depth)
+    batcher = MicroBatcher(engine.run, buckets=engine.buckets,
+                           batch_size=args.batch_size,
+                           max_wait_ms=args.max_wait_ms,
+                           admission=admission)
+    logger = MetricLogger(args.metrics, run_meta=dict(
+        mode='serve', buckets=list(buckets), batch_size=args.batch_size,
+        dtype=engine.dtype_name))
+    telemetry = ServeTelemetry(engine, batcher, admission, logger)
+    telemetry.arm()
+
+    # ---- the request stream: lengths cycle across buckets ----------- #
+    lows = [1] + [b + 1 for b in engine.buckets[:-1]]
+    lengths = [int(rng.randint(lows[i % len(buckets)],
+                               engine.buckets[i % len(buckets)] + 1))
+               for i in range(args.requests)]
+    lengths += [engine.max_len + int(rng.randint(1, 32))
+                for _ in range(args.oversize)]
+    rng.shuffle(lengths)
+
+    pending, flushed_at = [], 0
+    for length in lengths:
+        tokens = rng.randint(0, cfg.num_tokens, size=length)
+        coords = rng.normal(size=(length, 3)).astype(np.float32)
+        try:
+            pending.append(batcher.submit(tokens, coords))
+        except RequestRejected as e:
+            print(f'rejected: {e.code} {e.detail}')
+            logger.log_record('step', mirror=False, step=len(pending),
+                              rejected=e.to_record())
+        batcher.pump()
+        if batcher.batches_dispatched - flushed_at >= args.flush_every:
+            telemetry.flush()
+            flushed_at = batcher.batches_dispatched
+    # deadline-drain the stragglers, then close the stream
+    while batcher.queue_depth:
+        wait = batcher.next_deadline()
+        if wait:
+            time.sleep(wait)
+        batcher.pump()
+    telemetry.flush()
+    summary = telemetry.close()
+    logger.close()
+
+    # ---- gates + report --------------------------------------------- #
+    ok = True
+    unanswered = [p.request_id for p in pending if not p.ok]
+    if unanswered:
+        print(f'FAIL: {len(unanswered)} admitted requests unanswered')
+        ok = False
+    if telemetry.post_warmup_compiles:
+        print(f'FAIL: {telemetry.post_warmup_compiles} compile events '
+              f'after warmup — the AOT bucket contract is broken')
+        ok = False
+    if args.metrics:
+        try:
+            info = validate_stream(args.metrics)
+            print(f'schema ok: {info["records"]} records {info["kinds"]}')
+        except SchemaError as e:
+            print(f'FAIL: telemetry stream invalid: {e}')
+            ok = False
+
+    report = dict(
+        ok=ok,
+        requests=dict(total=len(lengths), answered=len(pending) -
+                      len(unanswered), **admission.snapshot()),
+        batches=batcher.batches_dispatched,
+        post_warmup_compiles=telemetry.post_warmup_compiles,
+        compile_seconds=engine.stats()['compile_seconds'],
+        latency_by_bucket={
+            k: {p: v[p] for p in
+                ('count', 'p50_ms', 'p95_ms', 'p99_ms', 'max_ms')}
+            for k, v in summary['timing'].items()
+            if k.startswith('bucket_')},
+        request_latency_ms=summary['metrics']['request_latency_ms'],
+        batch_fill=summary['metrics'].get('batch_fill'),
+    )
+    print(json.dumps(report, indent=2))
+    if args.out:
+        with open(args.out, 'w') as f:
+            json.dump(report, f, indent=2)
+        print(f'report -> {args.out}')
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

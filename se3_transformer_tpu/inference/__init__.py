@@ -1,0 +1,32 @@
+"""Inference/serving subsystem: AOT-precompiled bucketed engine with
+micro-batching, admission control, and SLO telemetry.
+
+The serving layer of the stack — it composes what training already
+built instead of duplicating it:
+
+  * `engine`    — `InferenceEngine`: params-only checkpoint restore
+    (`CheckpointManager.restore_params`), one AOT executable per shape
+    bucket (`jit(...).lower(...).compile()` at startup, cached per
+    `(bucket_len, batch_size, dtype)`), donated coords buffers off-CPU,
+    optional bf16 activation path.
+  * `batching`  — `MicroBatcher`: variable-length requests queued per
+    bucket, padded by the SAME `native.loader.pad_to_bucket` the
+    training dataset uses, flushed on batch-full or `max_wait_ms`.
+  * `admission` — `AdmissionController` + `RequestRejected`: oversize
+    requests (longer than the largest bucket) and overload (queue depth
+    at the shed threshold) are rejected with a structured error before
+    they can touch — let alone compile — anything.
+  * `telemetry` — `ServeTelemetry`: per-bucket latency p50/p95/p99 via
+    the engine's `PhaseTimer`, schema'd `serve` JSONL records, and the
+    RetraceWatchdog compile-event proof that a mixed-length request
+    stream causes zero post-warmup compiles.
+
+Entry point: `scripts/serve.py` (warmup -> serve loop -> summary
+report); smoke gate: `make serve-smoke`.
+"""
+from .admission import (  # noqa: F401
+    AdmissionController, OVERLOADED, OVERSIZE, RequestRejected,
+)
+from .batching import MicroBatcher, PendingResult  # noqa: F401
+from .engine import InferenceEngine, bucket_phase  # noqa: F401
+from .telemetry import ServeTelemetry  # noqa: F401

@@ -1,0 +1,105 @@
+"""Admission control: reject early, degrade gracefully.
+
+Two failure modes a bucketed AOT engine must never hit:
+
+  * an **oversize request** — a sequence longer than the largest compiled
+    bucket. Under `jax.jit` this would silently trigger a fresh multi-
+    second XLA compile (the classic serving cliff); with AOT executables
+    it would be a shape error deep in the engine. Either way the right
+    answer is a structured rejection at the front door.
+  * **queue collapse** — once the backlog exceeds what the engine can
+    drain within the deadline budget, every queued request's latency
+    grows without bound. Shedding load at a depth threshold keeps the
+    p99 of *admitted* requests flat instead of letting everyone time out.
+
+`RequestRejected` is an exception AND a record: `to_record()` returns the
+JSON-safe payload that rides the `serve` telemetry stream, so rejections
+are observable, not just raised.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+OVERSIZE = 'oversize'
+OVERLOADED = 'overloaded'
+
+
+def fit_bucket(buckets, length: int):
+    """Smallest bucket that fits `length`, or None. THE bucket-fit rule —
+    engine and batcher both route through it."""
+    for b in buckets:
+        if length <= b:
+            return b
+    return None
+
+
+def oversize_error(length: int, max_len: int) -> 'RequestRejected':
+    """THE oversize rejection payload (one constructor, three raisers)."""
+    return RequestRejected(
+        OVERSIZE,
+        f'request length {length} exceeds the largest compiled bucket '
+        f'({max_len}); recompile the engine with a larger bucket to '
+        f'serve it',
+        length=int(length), max_len=int(max_len))
+
+
+class RequestRejected(Exception):
+    """Structured rejection: `code` ('oversize' | 'overloaded') plus a
+    machine-readable `detail` dict (max_len / queue depth / limits)."""
+
+    def __init__(self, code: str, message: str, **detail):
+        super().__init__(message)
+        self.code = code
+        self.detail = dict(detail)
+
+    def to_record(self) -> dict:
+        return dict(code=self.code, message=str(self), **self.detail)
+
+
+class AdmissionController:
+    """Gate requests on length and backlog before they touch the engine.
+
+        ctl = AdmissionController(max_len=512, max_queue_depth=256)
+        ctl.admit(length=700, queue_depth=0)   # raises RequestRejected
+
+    Counters (`admitted`, `rejected`) feed the `serve` telemetry record
+    via `snapshot()`.
+    """
+
+    def __init__(self, max_len: int,
+                 max_queue_depth: Optional[int] = None):
+        assert max_len > 0, 'max_len must be positive'
+        self.max_len = int(max_len)
+        self.max_queue_depth = (int(max_queue_depth)
+                                if max_queue_depth is not None else None)
+        self.admitted = 0
+        self.rejected = {OVERSIZE: 0, OVERLOADED: 0}
+
+    def reject_oversize(self, length: int,
+                        max_len: Optional[int] = None) -> None:
+        """Count and raise an oversize rejection (callers that discover
+        the overflow themselves — e.g. the batcher's bucket fit — route
+        it through here so the counters stay truthful)."""
+        self.rejected[OVERSIZE] += 1
+        raise oversize_error(length, self.max_len if max_len is None
+                             else max_len)
+
+    def admit(self, length: int, queue_depth: int = 0) -> None:
+        """Raise RequestRejected if the request must not enter the queue;
+        otherwise count it admitted and return."""
+        if length > self.max_len:
+            self.reject_oversize(length)
+        if (self.max_queue_depth is not None
+                and queue_depth >= self.max_queue_depth):
+            self.rejected[OVERLOADED] += 1
+            raise RequestRejected(
+                OVERLOADED,
+                f'queue depth {queue_depth} at the shed threshold '
+                f'({self.max_queue_depth}); retry with backoff',
+                queue_depth=int(queue_depth),
+                max_queue_depth=self.max_queue_depth)
+        self.admitted += 1
+
+    def snapshot(self) -> dict:
+        """Cumulative counters for the serve record."""
+        return dict(admitted=self.admitted, rejected=dict(self.rejected))

@@ -1,0 +1,232 @@
+"""Micro-batching: variable-length requests -> fixed-shape bucket batches.
+
+Single requests waste a fixed-shape executable (a batch of 8 runs one
+request at 8x the per-request cost), but waiting forever for a full
+batch destroys tail latency. The `MicroBatcher` trades between them with
+exactly two knobs:
+
+  * flush-on-full — the moment a bucket's queue holds `batch_size`
+    requests, the batch dispatches (throughput bound);
+  * flush-on-deadline — `pump()` dispatches any bucket whose OLDEST
+    request has waited `max_wait_ms`, padding the short batch with
+    all-masked dummy rows (latency bound).
+
+Padding to the bucket reuses `native.loader.pad_to_bucket` — the same
+implementation the training dataset uses, so serving shapes cannot drift
+from the shapes the model was trained (and the engine compiled) on.
+
+The batcher is deliberately synchronous and single-threaded: `submit()`
+enqueues and returns a `PendingResult`, the serve loop calls `pump()`
+between accepts (and `drain()` at the end). That keeps it trivially
+testable (inject `clock`) and keeps all jax dispatch on one thread; an
+async front-end can wrap `submit`/`pump` without the core changing.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..native.loader import pad_to_bucket
+from .admission import AdmissionController, fit_bucket, oversize_error
+from .stats import agg_update, agg_zero
+
+
+class PendingResult:
+    """Future-lite: filled in by the flush that dispatches the request.
+    `done=True` with `error` set means the batch's runner raised — the
+    request was consumed but produced no result (`ok` distinguishes)."""
+
+    __slots__ = ('request_id', 'length', 'bucket', 'result', 'done',
+                 'error', 'submitted_at', 'completed_at')
+
+    def __init__(self, request_id: int, length: int, bucket: int,
+                 submitted_at: float):
+        self.request_id = request_id
+        self.length = length
+        self.bucket = bucket
+        self.result = None
+        self.done = False
+        self.error: Optional[BaseException] = None
+        self.submitted_at = submitted_at
+        self.completed_at: Optional[float] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.done and self.error is None
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.completed_at is None:
+            return None
+        return self.completed_at - self.submitted_at
+
+
+class _BucketQueue:
+    __slots__ = ('bucket', 'tokens', 'coords', 'pending')
+
+    def __init__(self, bucket: int):
+        self.bucket = bucket
+        self.tokens: List[np.ndarray] = []
+        self.coords: List[np.ndarray] = []
+        self.pending: List[PendingResult] = []
+
+    def __len__(self):
+        return len(self.pending)
+
+
+class MicroBatcher:
+    """Queue requests per length bucket; flush on batch-full or deadline.
+
+        batcher = MicroBatcher(engine.run, buckets=engine.buckets,
+                               batch_size=engine.batch_size,
+                               max_wait_ms=5.0, admission=ctl)
+        pending = batcher.submit(tokens, coords)   # may raise
+        batcher.pump()                             # deadline flushes
+        ...
+        batcher.drain()                            # end of stream
+
+    `runner(bucket, tokens, coords, mask) -> out [B, L, ...]` is the
+    engine's compiled entry; results are sliced back to each request's
+    true (unpadded) rows before resolving its `PendingResult`.
+    """
+
+    def __init__(self, runner: Callable, buckets: Sequence[int],
+                 batch_size: int, max_wait_ms: float = 10.0,
+                 admission: Optional[AdmissionController] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.runner = runner
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        assert self.buckets, 'no buckets'
+        self.batch_size = int(batch_size)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.admission = admission
+        self.clock = clock
+        self._queues = {b: _BucketQueue(b) for b in self.buckets}
+        self._next_id = 0
+        self.batches_dispatched = 0
+        self.rows_dispatched = 0       # real (non-dummy) rows
+        # real rows per dispatched batch: exact running stats forever,
+        # raw samples capped (a serve loop runs for days — every
+        # retention here must be bounded)
+        self.fill_stats = agg_zero()
+        self.fill_history: List[int] = []
+        self._fill_capacity = 4096
+        # completed results queue: DRAINED by the caller/telemetry via
+        # pop_completed(); bounded so an unobserved queue cannot grow
+        # without limit (oldest entries are dropped once over capacity —
+        # each request's submitter still holds its own PendingResult)
+        self.completed: List[PendingResult] = []
+        self._completed_capacity = 65536
+
+    # ------------------------------------------------------------------ #
+    @property
+    def queue_depth(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def bucket_for(self, length: int) -> Optional[int]:
+        return fit_bucket(self.buckets, length)
+
+    def submit(self, tokens, coords) -> PendingResult:
+        """Admit + enqueue one request; flushes its bucket if now full.
+
+        Raises RequestRejected (oversize / overloaded) WITHOUT touching
+        any compiled code path — rejection must never cost a compile.
+        The bucket fit is checked BEFORE admission accounting, so a
+        request no bucket can serve is counted rejected (never admitted)
+        even when the admission controller's max_len is looser than the
+        configured buckets.
+        """
+        tokens = np.asarray(tokens)
+        length = len(tokens)
+        bucket = self.bucket_for(length)
+        if bucket is None:
+            if self.admission is not None:
+                self.admission.reject_oversize(length, self.buckets[-1])
+            raise oversize_error(length, self.buckets[-1])
+        if self.admission is not None:
+            self.admission.admit(length, queue_depth=self.queue_depth)
+        q = self._queues[bucket]
+        pending = PendingResult(self._next_id, length, bucket, self.clock())
+        self._next_id += 1
+        q.tokens.append(tokens)
+        q.coords.append(np.asarray(coords, np.float32).reshape(-1, 3))
+        q.pending.append(pending)
+        if len(q) >= self.batch_size:
+            self._flush(q)
+        return pending
+
+    def pump(self, now: Optional[float] = None) -> int:
+        """Flush every bucket whose oldest request has hit the deadline.
+        Returns the number of batches dispatched."""
+        now = self.clock() if now is None else now
+        n = 0
+        for q in self._queues.values():
+            if q.pending and now - q.pending[0].submitted_at >= self.max_wait_s:
+                self._flush(q)
+                n += 1
+        return n
+
+    def drain(self) -> int:
+        """Flush every non-empty bucket regardless of deadline (end of a
+        request stream / shutdown). Returns batches dispatched."""
+        n = 0
+        for q in self._queues.values():
+            if q.pending:
+                self._flush(q)
+                n += 1
+        return n
+
+    def next_deadline(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds until the earliest pending deadline (sleep hint for a
+        serve loop); None when idle."""
+        oldest = [q.pending[0].submitted_at for q in self._queues.values()
+                  if q.pending]
+        if not oldest:
+            return None
+        now = self.clock() if now is None else now
+        return max(0.0, min(oldest) + self.max_wait_s - now)
+
+    def pop_completed(self) -> List[PendingResult]:
+        """Drain the completed-results queue (telemetry's latency feed)."""
+        done, self.completed = self.completed, []
+        return done
+
+    # ------------------------------------------------------------------ #
+    def _flush(self, q: _BucketQueue):
+        tokens, coords, mask = pad_to_bucket(
+            q.tokens, q.coords, q.bucket, batch_size=self.batch_size)
+        pending = q.pending
+        q.tokens, q.coords, q.pending = [], [], []
+        try:
+            out = np.asarray(self.runner(q.bucket, tokens, coords, mask))
+        except Exception as e:
+            # the queue is already cleared: resolve EVERY request in the
+            # batch with the error (done=True, ok=False) so no submitter
+            # is left holding a result that can never arrive, then
+            # re-raise for the serve loop's own handling
+            now = self.clock()
+            for p in pending:
+                p.error = e
+                p.done = True
+                p.completed_at = now
+                self.completed.append(p)
+            if len(self.completed) > self._completed_capacity:
+                del self.completed[:-self._completed_capacity]
+            raise
+        now = self.clock()
+        self.batches_dispatched += 1
+        self.rows_dispatched += len(pending)
+        agg_update(self.fill_stats, [len(pending)])
+        if len(self.fill_history) < self._fill_capacity:
+            self.fill_history.append(len(pending))
+        for row, p in enumerate(pending):
+            # copy: a view would pin the whole [B, L, ...] batch output
+            # alive for as long as any single request's result is held
+            p.result = np.array(out[row, :p.length])
+            p.done = True
+            p.completed_at = now
+            self.completed.append(p)
+        if len(self.completed) > self._completed_capacity:
+            del self.completed[:-self._completed_capacity]

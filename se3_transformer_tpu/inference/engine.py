@@ -1,0 +1,233 @@
+"""AOT-precompiled bucketed inference engine.
+
+Training amortizes one compile over thousands of steps; serving cannot —
+a request that triggers a fresh XLA compile pays seconds-to-minutes of
+latency, which on a tail percentile is an outage. The engine therefore
+moves ALL compilation to startup:
+
+  * `jax.jit(fn).lower(...).compile()` once per shape bucket, giving a
+    dict of AOT executables keyed `(bucket_len, batch_size, dtype)`.
+    AOT executables cannot retrace — an off-contract shape is a loud
+    TypeError at the engine boundary, never a silent compile (the
+    `RetraceWatchdog`'s compile-event counter doubles as the proof:
+    zero post-warmup events on a healthy engine).
+  * the bucket's chain adjacency is baked into each executable as a
+    trace-time constant (one fewer transfer per call), matching the
+    shapes `PointCloudDataset.batches` produces for training.
+  * `donate_buffers=True` (default off-CPU) donates the coords buffer —
+    the largest per-call input — back to XLA for output reuse.
+  * `activation_dtype=jnp.bfloat16` casts coords on the way in and the
+    output back to float32: the bf16 serving path, same equivariance
+    budget as the training-side `conv_bf16` option.
+
+Params stay a call argument (not baked), so a checkpoint refresh is
+`engine.params = mgr.restore_params()` — no recompile as long as shapes
+match. The persistent compilation cache (`utils.compilation_cache`)
+makes even the startup compiles warm across process restarts.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..native.loader import chain_adjacency, pad_to_bucket
+from ..observability import PhaseTimer
+from .admission import fit_bucket, oversize_error
+
+
+def bucket_phase(bucket: int) -> str:
+    """The PhaseTimer phase name for a bucket's execute latency."""
+    return f'bucket_{bucket}'
+
+
+class InferenceEngine:
+    """Restore params, precompile per bucket, answer fixed-shape batches.
+
+        module = DenoiseConfig(...).build_module()
+        engine = InferenceEngine.from_checkpoint(
+            module, '/ckpts/run1', buckets=(64, 128), batch_size=8)
+        out = engine.predict(tokens, coords)          # one request
+        out = engine.run(128, tokens, coords, mask)   # a padded batch
+
+    `run` is the `MicroBatcher` runner; `predict` is the convenience
+    single-request path (pads to the smallest fitting bucket). Both
+    block until the result is ready so the per-bucket PhaseTimer
+    percentiles are honest device latencies.
+    """
+
+    def __init__(self, module, params, *,
+                 buckets: Sequence[int] = (64, 128, 256, 512),
+                 batch_size: int = 1,
+                 return_type: int = 1,
+                 activation_dtype: Optional[jnp.dtype] = None,
+                 with_chain_adjacency: bool = True,
+                 donate_buffers: Optional[bool] = None,
+                 apply_kwargs: Optional[dict] = None,
+                 timer: Optional[PhaseTimer] = None,
+                 precompile: bool = True):
+        self.module = module
+        self.params = params         # property setter device_puts once
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        assert self.buckets, 'no buckets'
+        self.batch_size = int(batch_size)
+        self.return_type = return_type
+        self.activation_dtype = activation_dtype
+        self.with_chain_adjacency = with_chain_adjacency
+        if donate_buffers is None:
+            # donation is a no-op-with-warning on CPU; auto-enable only
+            # where the backend implements it
+            donate_buffers = jax.default_backend() != 'cpu'
+        self.donate_buffers = bool(donate_buffers)
+        self.apply_kwargs = dict(apply_kwargs or {})
+        self.timer = timer if timer is not None else PhaseTimer()
+        self._executables: Dict[Tuple[int, int, str], Callable] = {}
+        self.compile_seconds: Dict[Tuple[int, int, str], float] = {}
+        self.batches_served: Dict[int, int] = {b: 0 for b in self.buckets}
+        self.rows_served: Dict[int, int] = {b: 0 for b in self.buckets}
+        if precompile:
+            self.warmup()
+
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def from_checkpoint(cls, module, checkpoint_dir: str,
+                        step: Optional[int] = None, **kwargs
+                        ) -> 'InferenceEngine':
+        """Params-only restore (`CheckpointManager.restore_params`) —
+        optimizer state never materializes on the serving host."""
+        from ..training.checkpoint import CheckpointManager
+        params = CheckpointManager(checkpoint_dir).restore_params(step)
+        return cls(module, params, **kwargs)
+
+    # ------------------------------------------------------------------ #
+    @property
+    def params(self):
+        return self._params
+
+    @params.setter
+    def params(self, value):
+        # device_put ONCE per (re)load — restore_params hands back numpy
+        # leaves, and re-transferring the whole parameter set host-to-
+        # device on every run() call would dominate per-batch latency
+        # off-CPU. A setter so the checkpoint-refresh recipe
+        # `engine.params = mgr.restore_params()` stays fast too.
+        self._params = jax.device_put(value)
+
+    @property
+    def dtype_name(self) -> str:
+        return (jnp.dtype(self.activation_dtype).name
+                if self.activation_dtype is not None else 'float32')
+
+    def _key(self, bucket: int) -> Tuple[int, int, str]:
+        return (int(bucket), self.batch_size, self.dtype_name)
+
+    @property
+    def executables(self) -> Dict[Tuple[int, int, str], Callable]:
+        return dict(self._executables)
+
+    def _make_fn(self, bucket: int) -> Callable:
+        adj = (jnp.asarray(chain_adjacency(bucket))
+               if self.with_chain_adjacency else None)
+        act = self.activation_dtype
+        module, return_type, extra = (self.module, self.return_type,
+                                      self.apply_kwargs)
+
+        def fn(params, tokens, coords, mask):
+            if act is not None:
+                coords = coords.astype(act)
+            out = module.apply({'params': params}, tokens, coords,
+                               mask=mask, adj_mat=adj,
+                               return_type=return_type, **extra)
+            if act is not None:
+                out = jax.tree_util.tree_map(
+                    lambda x: x.astype(jnp.float32), out)
+            return out
+
+        return fn
+
+    def _abstract_batch(self, bucket: int):
+        B, L = self.batch_size, bucket
+        sds = jax.ShapeDtypeStruct
+        return (sds((B, L), jnp.int32), sds((B, L, 3), jnp.float32),
+                sds((B, L), jnp.bool_))
+
+    def compile_bucket(self, bucket: int) -> Callable:
+        """AOT lower+compile one bucket's executable (idempotent)."""
+        key = self._key(bucket)
+        if key in self._executables:
+            return self._executables[key]
+        assert bucket in self.buckets, f'{bucket} is not a configured bucket'
+        abstract_params = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(
+                np.shape(a), getattr(a, 'dtype', np.dtype(type(a)))),
+            self.params)
+        tokens, coords, mask = self._abstract_batch(bucket)
+        donate = (2,) if self.donate_buffers else ()  # coords buffer
+        t0 = time.perf_counter()
+        executable = (jax.jit(self._make_fn(bucket), donate_argnums=donate)
+                      .lower(abstract_params, tokens, coords, mask)
+                      .compile())
+        self.compile_seconds[key] = round(time.perf_counter() - t0, 3)
+        self._executables[key] = executable
+        return executable
+
+    def warmup(self) -> Dict[Tuple[int, int, str], float]:
+        """Compile every bucket; returns per-executable compile seconds.
+        Call before arming a RetraceWatchdog — afterwards a healthy
+        engine produces ZERO compile events."""
+        for b in self.buckets:
+            self.compile_bucket(b)
+        return dict(self.compile_seconds)
+
+    # ------------------------------------------------------------------ #
+    def bucket_for(self, length: int) -> Optional[int]:
+        return fit_bucket(self.buckets, length)
+
+    @property
+    def max_len(self) -> int:
+        return self.buckets[-1]
+
+    def run(self, bucket: int, tokens, coords, mask):
+        """Execute one padded fixed-shape batch on the bucket's AOT
+        executable; blocks until the result is ready (honest latency)."""
+        executable = self._executables.get(self._key(bucket))
+        if executable is None:
+            executable = self.compile_bucket(bucket)
+        with self.timer.phase(bucket_phase(bucket)):
+            out = executable(self.params, jnp.asarray(tokens, jnp.int32),
+                             jnp.asarray(coords, jnp.float32),
+                             jnp.asarray(mask, jnp.bool_))
+            out = jax.block_until_ready(out)
+        self.batches_served[bucket] += 1
+        self.rows_served[bucket] += int(np.asarray(mask).any(-1).sum())
+        return out
+
+    def predict(self, tokens, coords) -> np.ndarray:
+        """One request end to end: pad to the smallest fitting bucket,
+        run, return only the real (unpadded) rows."""
+        tokens = np.asarray(tokens)
+        length = len(tokens)
+        bucket = self.bucket_for(length)
+        if bucket is None:
+            raise oversize_error(length, self.max_len)
+        t, c, m = pad_to_bucket([tokens], [coords], bucket,
+                                batch_size=self.batch_size)
+        out = np.asarray(self.run(bucket, t, c, m))
+        return out[0, :length]
+
+    # ------------------------------------------------------------------ #
+    def stats(self) -> dict:
+        """Engine-side counters for the serve telemetry record."""
+        return dict(
+            buckets=list(self.buckets), batch_size=self.batch_size,
+            dtype=self.dtype_name,
+            executables=[list(k) for k in self._executables],
+            compile_seconds={str(k[0]): v
+                             for k, v in self.compile_seconds.items()},
+            batches_served={str(b): n
+                            for b, n in self.batches_served.items() if n},
+            rows_served={str(b): n
+                         for b, n in self.rows_served.items() if n})

@@ -1,0 +1,137 @@
+"""Serving telemetry: per-bucket SLO percentiles, schema'd `serve`
+records, and the zero-post-warmup-compile proof.
+
+Composes the observability primitives rather than inventing new ones:
+
+  * the engine's `PhaseTimer` already holds one `bucket_<L>` phase per
+    executable — `flush()` turns its window percentiles (p50/p95/p99)
+    into the `buckets` section of a `serve` record;
+  * a `RetraceWatchdog` rides along for its process-wide compile-event
+    counter: AOT executables cannot retrace, so after `arm()` ANY
+    compile event is a contract violation. `post_warmup_compiles`
+    accumulates the deltas — `scripts/serve.py` (and `make serve-smoke`)
+    gate on it being exactly zero;
+  * request latencies (queue wait + execute, from `MicroBatcher`'s
+    completed results) and batch fill fold into window-shaped metrics
+    for the end-of-run `summary` record.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..observability import MetricLogger, RetraceWatchdog
+from .admission import AdmissionController
+from .batching import MicroBatcher
+from .engine import InferenceEngine, bucket_phase
+
+
+from .stats import agg_stats, agg_update, agg_zero, window_stats
+
+
+class ServeTelemetry:
+    """Wire an engine + batcher + admission controller into the JSONL
+    telemetry stream.
+
+        tele = ServeTelemetry(engine, batcher, admission, logger)
+        engine.warmup()
+        tele.arm()              # baseline AFTER the startup compiles
+        ... serve ...
+        tele.flush()            # one `serve` record per interval
+        tele.close()            # cumulative `summary` record
+        assert tele.post_warmup_compiles == 0
+    """
+
+    def __init__(self, engine: InferenceEngine,
+                 batcher: Optional[MicroBatcher] = None,
+                 admission: Optional[AdmissionController] = None,
+                 logger: Optional[MetricLogger] = None,
+                 watchdog: Optional[RetraceWatchdog] = None):
+        self.engine = engine
+        self.batcher = batcher
+        self.admission = admission
+        self.logger = logger
+        self.watchdog = watchdog if watchdog is not None else \
+            RetraceWatchdog()
+        for key, executable in engine.executables.items():
+            self.watchdog.track(f'bucket_{key[0]}', executable)
+        self.post_warmup_compiles = 0
+        self._armed = False
+        self._latency_agg = agg_zero()
+        self.flush_count = 0
+
+    # ------------------------------------------------------------------ #
+    def arm(self):
+        """Baseline the compile counter after warmup: every compile event
+        from here on counts against the zero-post-warmup contract."""
+        self.watchdog.check()        # first check arms the watchdog
+        self._armed = True
+
+    def _drain_latencies(self):
+        if self.batcher is None:
+            return []
+        ms = [p.latency_s * 1e3 for p in self.batcher.pop_completed()
+              if p.latency_s is not None]
+        agg_update(self._latency_agg, ms)
+        return ms
+
+    def flush(self) -> dict:
+        """One schema'd `serve` record: per-bucket window percentiles,
+        request counters, queue depth, watchdog snapshot."""
+        timing = self.engine.timer.window_summary()
+        buckets = {str(b): timing[bucket_phase(b)]
+                   for b in self.engine.buckets
+                   if bucket_phase(b) in timing}
+        runtime = self.watchdog.check()
+        if self._armed:
+            self.post_warmup_compiles += runtime['compile_events_delta']
+        requests = dict(
+            served=sum(self.engine.rows_served.values()),
+            rejected=(self.admission.snapshot()['rejected']
+                      if self.admission else {}),
+        )
+        if self.admission is not None:
+            requests['admitted'] = self.admission.admitted
+        fields = dict(
+            requests=requests,
+            buckets=buckets,
+            queue_depth=(self.batcher.queue_depth
+                         if self.batcher is not None else 0),
+            runtime=runtime,
+            post_warmup_compiles=self.post_warmup_compiles,
+        )
+        latencies = self._drain_latencies()
+        if latencies:
+            fields['request_latency_ms'] = window_stats(latencies)
+        self.flush_count += 1
+        if self.logger is not None:
+            return self.logger.log_record('serve', **fields)
+        return fields
+
+    def close(self) -> dict:
+        """Cumulative `summary` record: total batches, request-latency /
+        batch-fill metric windows, per-bucket cumulative timing, the
+        engine's compile/serve counters, and the compile-event verdict."""
+        # a FINAL watchdog check: compile events between the last flush
+        # and close (e.g. a straggler drain) must not escape the verdict
+        runtime = self.watchdog.check()
+        if self._armed:
+            self.post_warmup_compiles += runtime['compile_events_delta']
+        self._drain_latencies()
+        metrics = dict(request_latency_ms=agg_stats(self._latency_agg))
+        if self.batcher is not None:
+            metrics['batch_fill'] = agg_stats(self.batcher.fill_stats)
+        fields = dict(
+            steps=(self.batcher.batches_dispatched
+                   if self.batcher is not None
+                   else sum(self.engine.batches_served.values())),
+            metrics=metrics,
+            timing=self.engine.timer.cumulative_summary(),
+            engine=self.engine.stats(),
+            post_warmup_compiles=self.post_warmup_compiles,
+            retrace_warnings_total=self.watchdog.warnings_total,
+        )
+        if self.admission is not None:
+            fields['requests'] = self.admission.snapshot()
+        if self.logger is not None:
+            return self.logger.log_record('summary', **fields)
+        return fields

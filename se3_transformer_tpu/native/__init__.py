@@ -1,4 +1,4 @@
 from .loader import (
     native_available, chain_adjacency, expand_adjacency, knn_graph,
-    pad_batch, get_lib,
+    pad_batch, pad_to_bucket, get_lib,
 )

@@ -129,6 +129,38 @@ def knn_graph(coords: np.ndarray, k: int, radius: float = np.inf
     return idx, dist, dist <= radius
 
 
+def pad_to_bucket(token_seqs, coord_seqs, bucket_len: int,
+                  batch_size: Optional[int] = None, pad_value: int = 0):
+    """THE pad-to-bucket implementation, shared by training
+    (`training/dataset.py:batches`) and serving
+    (`inference/batching.py:MicroBatcher`) so the two sides cannot drift:
+    a sequence padded for a serving bucket is bit-identical to the same
+    sequence padded for the training bucket.
+
+    Truncates each ragged sequence to `bucket_len`, pads to
+    tokens [B, bucket_len] / coords [B, bucket_len, 3] / mask
+    [B, bucket_len], and — when `batch_size` exceeds the number of
+    sequences — appends all-padding rows (mask False everywhere) so the
+    batch matches a fixed-shape compiled executable.
+    """
+    assert batch_size is None or len(token_seqs) <= batch_size, (
+        f'{len(token_seqs)} sequences do not fit a batch of {batch_size}')
+    toks = [np.asarray(t)[:bucket_len] for t in token_seqs]
+    crds = [np.asarray(c, np.float32).reshape(-1, 3)[:bucket_len]
+            for c in coord_seqs]
+    tokens, coords, mask = pad_batch(toks, crds, max_len=bucket_len,
+                                     pad_value=pad_value)
+    if batch_size is not None and tokens.shape[0] < batch_size:
+        extra = batch_size - tokens.shape[0]
+        tokens = np.concatenate(
+            [tokens, np.full((extra, bucket_len), pad_value, np.int32)])
+        coords = np.concatenate(
+            [coords, np.zeros((extra, bucket_len, 3), np.float32)])
+        mask = np.concatenate(
+            [mask, np.zeros((extra, bucket_len), bool)])
+    return tokens, coords, mask
+
+
 def pad_batch(token_seqs, coord_seqs, max_len: Optional[int] = None,
               pad_value: int = 0):
     """Ragged (tokens, coords) sequences -> padded [b, L] / [b, L, 3] batch

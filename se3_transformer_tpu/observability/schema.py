@@ -15,11 +15,19 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    memory), optional nodes_steps_per_sec.
   retrace_warning  a step function retraced after warmup (loud copy of
                    the flush's `retraced` payload).
+  serve            one per serving flush interval (inference subsystem):
+                   requests {admitted, served, rejected}, buckets
+                   (per-bucket latency {count, p50_ms, p95_ms, p99_ms,
+                   max_ms} — SLO percentiles are load-bearing, so p99 is
+                   REQUIRED here), queue_depth, runtime (watchdog
+                   snapshot), post_warmup_compiles (REQUIRED — the AOT
+                   zero-compile contract rides this field).
   summary          end-of-run cumulative record (metrics, timing,
                    nodes_steps_per_sec, loss trajectory,
                    retrace_warnings_total).
 
-`make obs-smoke` gates a 3-step CPU denoise run on `validate_stream`.
+`make obs-smoke` gates a 3-step CPU denoise run on `validate_stream`;
+`make serve-smoke` gates a mixed-length serving run the same way.
 """
 from __future__ import annotations
 
@@ -29,17 +37,24 @@ from typing import Iterable, Union
 
 SCHEMA_VERSION = 1
 
-KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'summary')
+KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'serve',
+               'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
     'step': ('run_id', 'step', 't'),
     'flush': ('run_id', 'step', 'window', 'timing', 'runtime'),
     'retrace_warning': ('run_id', 'retraced'),
+    # post_warmup_compiles is the load-bearing field of the AOT serving
+    # contract (must be 0) — a serve record without it is invalid
+    'serve': ('run_id', 'requests', 'buckets', 'runtime', 'queue_depth',
+              'post_warmup_compiles'),
     'summary': ('run_id', 'steps', 'metrics', 'timing'),
 }
 
 _TIMING_REQUIRED = ('count', 'p50_ms', 'p95_ms', 'max_ms')
+# serving SLOs are quoted at p99 — a serve record without it is invalid
+_SERVE_TIMING_REQUIRED = _TIMING_REQUIRED + ('p99_ms',)
 _WINDOW_REQUIRED = ('count', 'mean', 'min', 'max')
 
 
@@ -69,6 +84,21 @@ def validate_record(rec: dict, index=None) -> dict:
             _fail(index, 'run_meta.host must carry hostname and pid')
     if kind == 'step' and not isinstance(rec['step'], int):
         _fail(index, f'step must be an int, got {rec["step"]!r}')
+    if kind == 'serve':
+        requests = rec['requests']
+        if not isinstance(requests, dict) or 'served' not in requests \
+                or 'rejected' not in requests:
+            _fail(index, 'serve.requests must carry served and rejected')
+        buckets = rec['buckets']
+        if not isinstance(buckets, dict):
+            _fail(index, 'serve.buckets must be an object')
+        for bucket, st in buckets.items():
+            missing = [k for k in _SERVE_TIMING_REQUIRED
+                       if not isinstance(st, dict) or k not in st]
+            if missing:
+                _fail(index, f'buckets[{bucket!r}] missing {missing} '
+                             f'(per-bucket p50/p95/p99 are the SLO '
+                             f'surface)')
     if kind in ('flush', 'summary'):
         timing = rec['timing']
         if not isinstance(timing, dict):

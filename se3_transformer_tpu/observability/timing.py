@@ -63,6 +63,9 @@ def _percentiles(samples) -> dict:
     return dict(count=int(a.size),
                 p50_ms=round(float(np.percentile(a, 50)), 3),
                 p95_ms=round(float(np.percentile(a, 95)), 3),
+                # serving SLOs quote p99; training flush records simply
+                # carry it along (schema requires it only for `serve`)
+                p99_ms=round(float(np.percentile(a, 99)), 3),
                 max_ms=round(float(a.max()), 3),
                 mean_ms=round(float(a.mean()), 3))
 

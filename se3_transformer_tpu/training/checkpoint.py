@@ -91,6 +91,62 @@ class CheckpointManager:
         with open(self._step_dir(step) + '.pkl', 'rb') as f:
             return pickle.load(f)
 
+    @staticmethod
+    def _params_subtree(tree, key_of=lambda k: k):
+        """Locate the params subtree under the repo's state conventions:
+        (params, opt_state, step) tuples/lists -> element 0, dicts with a
+        'params' key -> that entry, anything else -> the whole tree (a
+        params-only checkpoint). Returns (key-or-None, subtree)."""
+        if isinstance(tree, (tuple, list)):
+            return key_of(0), tree[0]
+        if isinstance(tree, dict) and 'params' in tree:
+            return key_of('params'), tree['params']
+        return None, tree
+
+    def restore_params(self, step: Optional[int] = None) -> Any:
+        """Params-only restore for inference/serving.
+
+        The orbax path reads JUST the params subtree from the store
+        (PyTreeRestore with an item/transforms pair that names only the
+        params keys), so optimizer moments — 2x the params footprint for
+        adam — are never materialized in host or device memory. The
+        pickle fallback necessarily loads the one blob, then drops
+        everything but params. Leaves come back as numpy arrays; feed
+        them to `InferenceEngine` (which device-puts them once at
+        construction) or jax.device_put them yourself.
+        """
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f'no checkpoints in {self.directory}')
+        path = self._step_dir(step)
+        if self._ckptr is not None and os.path.isdir(path):
+            # tuple-rooted states flatten to string keys '0', '1', ... in
+            # the orbax store; metadata gives the saved structure without
+            # reading any array data
+            meta = self._ckptr.metadata(path)
+            key, params_meta = self._params_subtree(meta, key_of=str)
+
+            def walk(node, fn):
+                if isinstance(node, dict):
+                    return {k: walk(v, fn) for k, v in node.items()}
+                if isinstance(node, (tuple, list)):
+                    return {str(i): walk(v, fn) for i, v in enumerate(node)}
+                return fn(node)
+
+            item = walk(params_meta, lambda m: 0)
+            rargs = walk(params_meta,
+                         lambda m: ocp.RestoreArgs(restore_type=np.ndarray))
+            if key is not None:
+                item, rargs = {key: item}, {key: rargs}
+            ckptr = ocp.PyTreeCheckpointer()
+            restored = ckptr.restore(
+                path, args=ocp.args.PyTreeRestore(
+                    item=item, restore_args=rargs, transforms={}))
+            return restored[key] if key is not None else restored
+        with open(path + '.pkl', 'rb') as f:
+            state = pickle.load(f)
+        return self._params_subtree(state)[1]
+
     def _gc(self):
         steps = self.all_steps()
         for step in steps[:-self.max_to_keep]:

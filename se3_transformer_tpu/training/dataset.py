@@ -19,11 +19,12 @@ layout — no framework changes needed.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from ..native.loader import chain_adjacency, pad_batch
+from ..native.loader import chain_adjacency, pad_to_bucket
 
 
 def save_point_cloud_dataset(path: str, token_seqs: Sequence[np.ndarray],
@@ -66,6 +67,9 @@ class PointCloudDataset:
     tokens: np.ndarray           # [sum L] int32
     coords: np.ndarray          # [sum L, 3] float32
     masks: Optional[np.ndarray] = None  # [sum L] bool, None = all valid
+    # sequences the last batches(drop_longer=True) call discarded for
+    # exceeding the largest bucket (set eagerly, before the first yield)
+    last_dropped: int = 0
 
     @classmethod
     def load(cls, path: str) -> 'PointCloudDataset':
@@ -100,7 +104,11 @@ class PointCloudDataset:
         the bucket size, so each bucket compiles exactly once downstream.
         Sequences longer than the largest bucket are dropped (the
         reference skips >500-residue proteins the same way, denoise.py:15)
-        unless drop_longer=False, in which case they are truncated.
+        unless drop_longer=False, in which case they are truncated. Drops
+        are counted eagerly (before the first yield): the count lands in
+        `self.last_dropped` and a single UserWarning carries it — a
+        dataset silently shrinking to a fraction of itself was previously
+        invisible.
 
         Fixed shapes require full batches, so each bucket's trailing
         partial batch is dropped for that pass; vary `shuffle_seed` per
@@ -113,6 +121,7 @@ class PointCloudDataset:
         off = self._offsets()
 
         by_bucket: List[List[int]] = [[] for _ in buckets]
+        dropped = 0
         for i, L in enumerate(self.lengths):
             placed = False
             for bi, b in enumerate(buckets):
@@ -120,33 +129,47 @@ class PointCloudDataset:
                     by_bucket[bi].append(i)
                     placed = True
                     break
-            if not placed and not drop_longer:
-                by_bucket[-1].append(i)  # will be truncated to the bucket
+            if not placed:
+                if drop_longer:
+                    dropped += 1
+                else:
+                    by_bucket[-1].append(i)  # truncated to the bucket
+        self.last_dropped = dropped
+        if dropped:
+            warnings.warn(
+                f'PointCloudDataset.batches: dropped {dropped} of '
+                f'{len(self.lengths)} sequences longer than the largest '
+                f'bucket ({buckets[-1]}); add a larger bucket or pass '
+                f'drop_longer=False to truncate instead', stacklevel=2)
 
         rng = np.random.RandomState(shuffle_seed) \
             if shuffle_seed is not None else None
 
-        for bi, idxs in enumerate(by_bucket):
-            if rng is not None:
-                idxs = list(rng.permutation(idxs))
-            L = buckets[bi]
-            adj = chain_adjacency(L) if with_chain_adjacency else None
-            for start in range(0, len(idxs) - batch_size + 1, batch_size):
-                chosen = idxs[start:start + batch_size]
-                toks, crds = [], []
-                for i in chosen:
-                    s, e = off[i], off[i + 1]
-                    toks.append(self.tokens[s:e][:L])
-                    crds.append(self.coords[s:e][:L])
-                tokens, coords, mask = pad_batch(toks, crds, max_len=L)
-                if self.masks is not None:
-                    # padding mask AND per-node resolution mask
-                    for row, i in enumerate(chosen):
+        def generate() -> Iterator[dict]:
+            for bi, idxs in enumerate(by_bucket):
+                order = list(rng.permutation(idxs)) if rng is not None \
+                    else idxs
+                L = buckets[bi]
+                adj = chain_adjacency(L) if with_chain_adjacency else None
+                for start in range(0, len(order) - batch_size + 1,
+                                   batch_size):
+                    chosen = order[start:start + batch_size]
+                    toks, crds = [], []
+                    for i in chosen:
                         s, e = off[i], off[i + 1]
-                        m = self.masks[s:e][:L]
-                        mask[row, :len(m)] &= m
-                batch = dict(tokens=tokens, coords=coords, mask=mask,
-                             bucket=L)
-                if adj is not None:
-                    batch['adj_mat'] = adj
-                yield batch
+                        toks.append(self.tokens[s:e])
+                        crds.append(self.coords[s:e])
+                    tokens, coords, mask = pad_to_bucket(toks, crds, L)
+                    if self.masks is not None:
+                        # padding mask AND per-node resolution mask
+                        for row, i in enumerate(chosen):
+                            s, e = off[i], off[i + 1]
+                            m = self.masks[s:e][:L]
+                            mask[row, :len(m)] &= m
+                    batch = dict(tokens=tokens, coords=coords, mask=mask,
+                                 bucket=L)
+                    if adj is not None:
+                        batch['adj_mat'] = adj
+                    yield batch
+
+        return generate()

@@ -1,0 +1,386 @@
+"""Inference/serving subsystem tests: AOT per-bucket precompile, the
+micro-batcher's two flush triggers, structured admission rejections,
+padded-vs-unpadded output parity on real rows, the params-only
+checkpoint restore (orbax AND pickle paths), and the `serve` telemetry
+record schema. The model is the smallest trainable config so the bucket
+compiles stay cheap; batcher/admission tests use a fake runner and an
+injected clock (no compiles, no sleeps)."""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from se3_transformer_tpu.inference import (
+    AdmissionController, InferenceEngine, MicroBatcher, RequestRejected,
+    ServeTelemetry,
+)
+from se3_transformer_tpu.native.loader import chain_adjacency
+from se3_transformer_tpu.observability.schema import (
+    SchemaError, validate_record,
+)
+
+BUCKETS = (6, 10)
+BATCH = 2
+
+
+def _tiny_module():
+    from se3_transformer_tpu.training.denoise import DenoiseConfig
+    return DenoiseConfig(num_tokens=8, dim=4, dim_head=4, heads=1,
+                         depth=1, num_degrees=2,
+                         max_sparse_neighbors=4).build_module()
+
+
+@pytest.fixture(scope='module')
+def engine():
+    module = _tiny_module()
+    rng = np.random.RandomState(0)
+    L = BUCKETS[0]
+    params = module.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(rng.randint(0, 8, size=(1, L))),
+        jnp.asarray(rng.normal(size=(1, L, 3)).astype(np.float32)),
+        mask=jnp.ones((1, L), bool),
+        adj_mat=jnp.asarray(chain_adjacency(L)),
+        return_type=1)['params']
+    return InferenceEngine(module, params, buckets=BUCKETS,
+                           batch_size=BATCH, return_type=1)
+
+
+def _request(rng, length):
+    return (rng.randint(0, 8, size=length),
+            rng.normal(size=(length, 3)).astype(np.float32))
+
+
+# --------------------------------------------------------------------- #
+# engine: AOT precompile + zero post-warmup compiles on a mixed stream
+# --------------------------------------------------------------------- #
+def test_engine_precompiles_every_bucket(engine):
+    keys = set(engine.executables)
+    assert keys == {(6, BATCH, 'float32'), (10, BATCH, 'float32')}
+    # AOT executables expose no trace cache — they cannot retrace
+    assert all(not hasattr(ex, '_cache_size')
+               for ex in engine.executables.values())
+    assert set(engine.compile_seconds) == keys
+
+
+def test_mixed_stream_causes_zero_post_warmup_compiles(engine):
+    ctl = AdmissionController(max_len=engine.max_len, max_queue_depth=8)
+    batcher = MicroBatcher(engine.run, buckets=engine.buckets,
+                           batch_size=BATCH, max_wait_ms=0.0,
+                           admission=ctl)
+    telemetry = ServeTelemetry(engine, batcher, ctl)
+    telemetry.arm()                      # post-warmup baseline
+    rng = np.random.RandomState(1)
+    pending = []
+    for length in (3, 6, 8, 10, 5, 9):   # spans both buckets
+        pending.append(batcher.submit(*_request(rng, length)))
+        batcher.pump(now=batcher.clock() + 1.0)   # force deadline flush
+    assert all(p.done for p in pending)
+    rec = telemetry.flush()
+    assert rec['post_warmup_compiles'] == 0
+    assert rec['runtime']['compile_events_delta'] == 0
+    # per-bucket SLO percentiles present and schema-valid
+    assert set(rec['buckets']) == {'6', '10'}
+    for stats in rec['buckets'].values():
+        assert {'count', 'p50_ms', 'p95_ms', 'p99_ms', 'max_ms'} <= \
+            set(stats)
+    validate_record(dict(rec, kind='serve', run_id='t'))
+    summary = telemetry.close()
+    assert summary['post_warmup_compiles'] == 0
+    assert summary['metrics']['request_latency_ms']['count'] == 6
+
+
+def test_padded_batch_matches_unpadded_single_request(engine):
+    """The acceptance criterion: a request padded into its bucket (plus
+    dummy rows padded into the batch) must answer exactly what the
+    unpadded model answers on the real rows."""
+    rng = np.random.RandomState(2)
+    length = 5
+    tokens, coords = _request(rng, length)
+    padded = engine.predict(tokens, coords)
+    assert padded.shape == (length, 3)
+
+    module = engine.module
+    ref = module.apply(
+        {'params': engine.params}, jnp.asarray(tokens[None]),
+        jnp.asarray(coords[None]), mask=jnp.ones((1, length), bool),
+        adj_mat=jnp.asarray(chain_adjacency(length)), return_type=1)
+    np.testing.assert_allclose(padded, np.asarray(ref)[0],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_engine_oversize_predict_rejects_without_compiling(engine):
+    n_exec = len(engine.executables)
+    rng = np.random.RandomState(3)
+    with pytest.raises(RequestRejected) as e:
+        engine.predict(*_request(rng, engine.max_len + 1))
+    assert e.value.code == 'oversize'
+    assert e.value.detail['max_len'] == engine.max_len
+    assert len(engine.executables) == n_exec   # nothing new compiled
+
+
+# --------------------------------------------------------------------- #
+# micro-batcher: flush-on-full / flush-on-deadline (fake runner+clock)
+# --------------------------------------------------------------------- #
+class _FakeRunner:
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, bucket, tokens, coords, mask):
+        self.calls.append((bucket, tokens.shape, mask.copy()))
+        return np.broadcast_to(
+            np.arange(tokens.shape[1], dtype=np.float32)[None, :, None],
+            tokens.shape + (3,))
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_flush_on_full_dispatches_immediately():
+    runner, clock = _FakeRunner(), _FakeClock()
+    mb = MicroBatcher(runner, buckets=(8,), batch_size=2,
+                      max_wait_ms=1e9, clock=clock)
+    rng = np.random.RandomState(0)
+    p1 = mb.submit(*_request(rng, 3))
+    assert not p1.done and not runner.calls
+    p2 = mb.submit(*_request(rng, 8))
+    # second request fills the batch: dispatched with no pump, no wait
+    assert p1.done and p2.done and len(runner.calls) == 1
+    assert runner.calls[0][0] == 8 and runner.calls[0][1] == (2, 8)
+    # results sliced back to the true lengths
+    assert p1.result.shape == (3, 3) and p2.result.shape == (8, 3)
+    np.testing.assert_array_equal(p1.result[:, 0], [0, 1, 2])
+
+
+def test_flush_on_deadline_pads_partial_batch():
+    runner, clock = _FakeRunner(), _FakeClock()
+    mb = MicroBatcher(runner, buckets=(4, 8), batch_size=3,
+                      max_wait_ms=10.0, clock=clock)
+    rng = np.random.RandomState(0)
+    p = mb.submit(*_request(rng, 3))
+    assert mb.pump() == 0 and not p.done        # deadline not reached
+    assert mb.next_deadline() == pytest.approx(0.010)
+    clock.t += 0.005
+    assert mb.pump() == 0 and not p.done        # still inside the window
+    clock.t += 0.006
+    assert mb.pump() == 1 and p.done            # deadline flush
+    bucket, shape, mask = runner.calls[0]
+    assert bucket == 4 and shape == (3, 4)      # padded to full batch
+    assert mask[0, :3].all() and not mask[1:].any()  # dummy rows masked
+    assert mb.fill_history == [1]
+    assert p.latency_s == pytest.approx(0.011)
+
+
+def test_runner_failure_resolves_every_request_with_the_error():
+    """A transient runner exception must not strand the batch: every
+    request resolves done-with-error (no submitter hangs forever), and
+    the exception still propagates to the serve loop."""
+    class _Boom(Exception):
+        pass
+
+    def exploding_runner(bucket, tokens, coords, mask):
+        raise _Boom('device OOM')
+
+    mb = MicroBatcher(exploding_runner, buckets=(8,), batch_size=2,
+                      max_wait_ms=1e9, clock=_FakeClock())
+    rng = np.random.RandomState(0)
+    p1 = mb.submit(*_request(rng, 3))
+    with pytest.raises(_Boom):
+        mb.submit(*_request(rng, 4))    # fills the batch -> flush raises
+    assert p1.done and not p1.ok and isinstance(p1.error, _Boom)
+    assert p1.result is None
+    assert mb.queue_depth == 0          # consumed, not silently requeued
+    assert len(mb.pop_completed()) == 2
+
+
+def test_drain_flushes_all_buckets():
+    runner, clock = _FakeRunner(), _FakeClock()
+    mb = MicroBatcher(runner, buckets=(4, 8), batch_size=4,
+                      max_wait_ms=1e9, clock=clock)
+    rng = np.random.RandomState(0)
+    ps = [mb.submit(*_request(rng, n)) for n in (2, 6)]
+    assert mb.queue_depth == 2
+    assert mb.drain() == 2
+    assert all(p.done for p in ps) and mb.queue_depth == 0
+    assert mb.next_deadline() is None
+
+
+# --------------------------------------------------------------------- #
+# admission control
+# --------------------------------------------------------------------- #
+def test_oversize_rejected_structurally():
+    ctl = AdmissionController(max_len=16)
+    mb = MicroBatcher(_FakeRunner(), buckets=(16,), batch_size=2,
+                      admission=ctl)
+    rng = np.random.RandomState(0)
+    with pytest.raises(RequestRejected) as e:
+        mb.submit(*_request(rng, 17))
+    rec = e.value.to_record()
+    assert rec['code'] == 'oversize'
+    assert rec['length'] == 17 and rec['max_len'] == 16
+    assert mb.queue_depth == 0                  # never enqueued
+    assert ctl.snapshot() == dict(
+        admitted=0, rejected=dict(oversize=1, overloaded=0))
+
+
+def test_oversize_counted_rejected_even_with_loose_admission_max_len():
+    """Regression: with admission.max_len looser than the configured
+    buckets, an unservable request used to count as admitted and then
+    raise with no rejected-counter increment."""
+    ctl = AdmissionController(max_len=600)      # looser than the buckets
+    mb = MicroBatcher(_FakeRunner(), buckets=(16,), batch_size=2,
+                      admission=ctl)
+    rng = np.random.RandomState(0)
+    with pytest.raises(RequestRejected) as e:
+        mb.submit(*_request(rng, 20))           # fits max_len, no bucket
+    assert e.value.code == 'oversize'
+    assert e.value.detail['max_len'] == 16      # the real serving limit
+    assert ctl.snapshot() == dict(
+        admitted=0, rejected=dict(oversize=1, overloaded=0))
+
+
+def test_queue_depth_sheds_load():
+    ctl = AdmissionController(max_len=16, max_queue_depth=2)
+    mb = MicroBatcher(_FakeRunner(), buckets=(16,), batch_size=8,
+                      admission=ctl, max_wait_ms=1e9)
+    rng = np.random.RandomState(0)
+    mb.submit(*_request(rng, 4))
+    mb.submit(*_request(rng, 4))
+    with pytest.raises(RequestRejected) as e:
+        mb.submit(*_request(rng, 4))
+    assert e.value.code == 'overloaded'
+    assert e.value.detail['queue_depth'] == 2
+    # backlog drains -> admission resumes
+    mb.drain()
+    mb.submit(*_request(rng, 4))
+    assert ctl.admitted == 3
+
+
+# --------------------------------------------------------------------- #
+# serve record schema
+# --------------------------------------------------------------------- #
+def test_serve_record_schema_requires_p99():
+    good = dict(kind='serve', run_id='r',
+                requests=dict(served=3, rejected=dict(oversize=1)),
+                buckets={'64': dict(count=2, p50_ms=1.0, p95_ms=2.0,
+                                    p99_ms=2.5, max_ms=3.0)},
+                runtime=dict(compile_events_delta=0),
+                queue_depth=0, post_warmup_compiles=0)
+    validate_record(good)
+    bad = dict(good)
+    bad['buckets'] = {'64': dict(count=2, p50_ms=1.0, p95_ms=2.0,
+                                 max_ms=3.0)}   # p99 missing
+    with pytest.raises(SchemaError, match='p99'):
+        validate_record(bad)
+    with pytest.raises(SchemaError, match='served'):
+        validate_record(dict(good, requests=dict()))
+    # the zero-compile contract field itself is required
+    missing = {k: v for k, v in good.items()
+               if k != 'post_warmup_compiles'}
+    with pytest.raises(SchemaError, match='post_warmup_compiles'):
+        validate_record(missing)
+
+
+# --------------------------------------------------------------------- #
+# params-only checkpoint restore (orbax and pickle fallback paths)
+# --------------------------------------------------------------------- #
+def _fake_state():
+    params = {'dense': {'kernel': np.arange(12, dtype=np.float32)
+                        .reshape(3, 4),
+                        'bias': np.ones(4, np.float32)}}
+    opt_state = ({'mu': np.full((3, 4), 2.0, np.float32)},
+                 {'nu': np.full((3, 4), 3.0, np.float32)})
+    return params, opt_state
+
+
+def _assert_params_match(restored, params):
+    got = jax.tree_util.tree_leaves(restored)
+    want = jax.tree_util.tree_leaves(params)
+    assert len(got) == len(want)
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize('force_pickle', [False, True],
+                         ids=['orbax', 'pickle'])
+def test_restore_params_only(tmp_path, force_pickle):
+    from se3_transformer_tpu.training.checkpoint import CheckpointManager
+    params, opt_state = _fake_state()
+    mgr = CheckpointManager(str(tmp_path / 'ckpt'))
+    if force_pickle:
+        mgr._ckptr = None
+    mgr.save(4, (params, opt_state, 4))
+    restored = mgr.restore_params()
+    _assert_params_match(restored, params)
+    # explicit step addressing works too
+    _assert_params_match(mgr.restore_params(step=4), params)
+    with pytest.raises(FileNotFoundError):
+        CheckpointManager(str(tmp_path / 'empty')).restore_params()
+
+
+def test_restore_params_dict_rooted_state(tmp_path):
+    from se3_transformer_tpu.training.checkpoint import CheckpointManager
+    params, opt_state = _fake_state()
+    mgr = CheckpointManager(str(tmp_path / 'ckpt'))
+    mgr.save(1, {'params': params, 'opt_state': opt_state, 'step': 1})
+    _assert_params_match(mgr.restore_params(), params)
+
+
+# --------------------------------------------------------------------- #
+# shared padding: serving and training shapes cannot drift
+# --------------------------------------------------------------------- #
+def test_batcher_padding_matches_dataset_padding(tmp_path):
+    """The same sequence padded by the serving batcher and by the
+    training dataset must be bit-identical (one pad implementation)."""
+    from se3_transformer_tpu.training.dataset import (
+        PointCloudDataset, save_point_cloud_dataset,
+    )
+    rng = np.random.RandomState(0)
+    toks = [rng.randint(0, 8, L) for L in (5, 7)]
+    crds = [rng.normal(size=(L, 3)).astype(np.float32) for L in (5, 7)]
+    path = save_point_cloud_dataset(str(tmp_path / 'ds'), toks, crds)
+    ds = PointCloudDataset.load(path)
+    [train_batch] = list(ds.batches(batch_size=2, buckets=(8,),
+                                    shuffle_seed=None))
+
+    runner = _FakeRunner()
+    mb = MicroBatcher(runner, buckets=(8,), batch_size=2)
+    mb.submit(toks[0], crds[0])
+    mb.submit(toks[1], crds[1])
+    _, _, serve_mask = runner.calls[0]
+    np.testing.assert_array_equal(serve_mask, train_batch['mask'])
+
+
+def test_dataset_counts_and_warns_on_dropped_oversize(tmp_path):
+    """Regression: `batches` used to silently drop sequences longer than
+    the largest bucket — now it counts, warns once, and exposes it."""
+    from se3_transformer_tpu.training.dataset import (
+        PointCloudDataset, save_point_cloud_dataset,
+    )
+    rng = np.random.RandomState(0)
+    lengths = (4, 6, 20, 30)                # two exceed the 8-bucket
+    toks = [rng.randint(0, 8, L) for L in lengths]
+    crds = [rng.normal(size=(L, 3)).astype(np.float32) for L in lengths]
+    path = save_point_cloud_dataset(str(tmp_path / 'ds'), toks, crds)
+    ds = PointCloudDataset.load(path)
+
+    with pytest.warns(UserWarning, match='dropped 2 of 4'):
+        batches = list(ds.batches(batch_size=2, buckets=(8,)))
+    assert ds.last_dropped == 2
+    assert len(batches) == 1
+    # the count is eager: set even before the iterator is consumed
+    with pytest.warns(UserWarning, match='dropped 2'):
+        ds.batches(batch_size=2, buckets=(8,))
+    assert ds.last_dropped == 2
+    # truncation path drops nothing and stays silent
+    with warnings.catch_warnings():
+        warnings.simplefilter('error')
+        list(ds.batches(batch_size=2, buckets=(8,), drop_longer=False))
+    assert ds.last_dropped == 0

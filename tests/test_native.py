@@ -4,7 +4,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from se3_transformer_tpu.native import (
-    chain_adjacency, expand_adjacency, knn_graph, native_available, pad_batch,
+    chain_adjacency, expand_adjacency, knn_graph, native_available,
+    pad_batch, pad_to_bucket,
 )
 from se3_transformer_tpu.native import loader
 from se3_transformer_tpu.ops.neighbors import (
@@ -70,3 +71,21 @@ def test_pad_batch():
     assert m.sum() == 5
     t2, c2, m2 = _with_numpy_fallback(pad_batch, tokens, coords, max_len=6)
     assert (c == c2).all() and (m == m2).all()
+
+
+def test_pad_to_bucket_truncates_and_row_fills():
+    # the shared training/serving bucket padder: truncation to the
+    # bucket, all-masked dummy rows up to batch_size, plain pad_batch
+    # semantics otherwise
+    tokens = [[1, 2, 3, 4, 5], [6]]
+    coords = [np.ones((5, 3)), 2 * np.ones((1, 3))]
+    t, c, m = pad_to_bucket(tokens, coords, bucket_len=3, batch_size=4)
+    assert t.shape == (4, 3) and c.shape == (4, 3, 3) and m.shape == (4, 3)
+    assert (t[0] == [1, 2, 3]).all()          # truncated to the bucket
+    assert m[0].all() and m[1].tolist() == [True, False, False]
+    assert not m[2:].any() and (t[2:] == 0).all()   # dummy rows masked
+    # without batch_size: identical to pad_batch at the bucket length
+    t1, c1, m1 = pad_to_bucket(tokens, coords, bucket_len=3)
+    t2, c2, m2 = pad_batch([s[:3] for s in tokens],
+                           [np.asarray(x)[:3] for x in coords], max_len=3)
+    assert (t1 == t2).all() and (c1 == c2).all() and (m1 == m2).all()

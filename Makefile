@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke pipeline-smoke clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -32,6 +32,11 @@ obs-smoke:         ## 3-step CPU denoise with telemetry: schema-gates the JSONL,
 serve-smoke:       ## 3-request CPU serving run (2 buckets + 1 oversize reject): exits non-zero unless the telemetry stream is schema-valid AND zero post-warmup compiles fired
 	rm -f /tmp/serve_smoke.jsonl
 	python scripts/serve.py --requests 3 --oversize 1 --buckets 12,24 --batch-size 2 --cpu --metrics /tmp/serve_smoke.jsonl --out /tmp/serve_smoke_summary.json
+
+pipeline-smoke:    ## 6-step pipelined CPU denoise (docs/PERFORMANCE.md): exits non-zero on schema violation or a 100% prefetch-stall rate
+	rm -f /tmp/pipeline_smoke.jsonl
+	python denoise.py --steps 6 --nodes 48 --accum 2 --cpu --pipelined --telemetry --flush-every 3 --metrics /tmp/pipeline_smoke.jsonl
+	python scripts/obs_report.py /tmp/pipeline_smoke.jsonl --validate --require-pipeline --out /tmp/pipeline_smoke_summary.json
 
 tpu-checks:        ## on-chip equivariance + kernel numerics/speed gate
 	python scripts/tpu_checks.py

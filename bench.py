@@ -149,7 +149,8 @@ def _device_backend_or_cpu(timeouts=(120, 240, 600), sleep_s: int = 30):
 DEFAULT_MODE = 'auto'
 
 
-def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
+def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None,
+         pipelined=False):
     """fast=True enables the validated perf knobs (shared radial trunk,
     basis-fused Pallas kernel, bf16 radial) — same model family, same
     training task. Accuracy evidence: equivariance_l2 is measured on
@@ -160,7 +161,19 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
     tries the fast path and falls back to the conservative one on any
     failure (record flagged fast_fallback). Default: the
     SE3_TPU_BENCH_FAST env var ('1'/'true'/'auto'/...), else
-    DEFAULT_MODE."""
+    DEFAULT_MODE.
+
+    pipelined=True (`python bench.py --pipelined`) measures a DIFFERENT
+    program from the records above: host batches are REBUILT every step
+    (the synchronous records reuse one fixed device batch, i.e. zero
+    host batch-build time) and the run compares a synchronous
+    build->transfer->step loop against the training.pipeline overlapped
+    path (BatchProducer thread + device_prefetch) on the SAME
+    executable. The record's value is the pipelined rate; it carries
+    the sync arm's rate, a `pipeline` payload (prefetch hits/stalls,
+    producer-bound vs device-bound verdict — same shape as the schema'd
+    pipeline JSONL record), and never compares against the synchronous
+    RECORD anchors."""
     import jax
 
     # any accelerator name counts as the chip (axon/tpu/...); only 'cpu'
@@ -174,7 +187,8 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
 
     if fast == 'auto':
         try:
-            return main(backend, fast=True, fallback_reason=fallback_reason)
+            return main(backend, fast=True, fallback_reason=fallback_reason,
+                        pipelined=pipelined)
         except Exception:  # noqa: BLE001 - any fast-path failure
             import traceback
             traceback.print_exc(file=sys.stderr)
@@ -184,7 +198,8 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
             # record could be misread downstream as a normal fast run
             # (ADVICE r2 #3)
             return main(backend, fast=False, fast_fallback=True,
-                        fallback_reason=fallback_reason)
+                        fallback_reason=fallback_reason,
+                        pipelined=pipelined)
 
     if not on_chip:
         # NOTE: setting the JAX_PLATFORMS env var here is too late — the
@@ -354,13 +369,78 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
     # across windows, so the loss trajectory spans all 2*steps steps.
     losses = []
     window_rates = []
+    pipeline_snapshot = None
+    sync_rate = None
+    if pipelined:
+        # ---- pipelined data-path A/B -------------------------------- #
+        # Different program from the fixed-batch records: host batches
+        # are REBUILT per step in both arms, so the comparison isolates
+        # the overlap (producer thread + device prefetch) from the host
+        # work itself. Both arms run the SAME compiled executable (no
+        # second compile on chip) and two windows each, best-of-window
+        # (the established one-sided-noise estimator).
+        from se3_transformer_tpu.training.pipeline import (
+            BatchProducer, PipelineStats, device_prefetch,
+        )
+
+        host_rng = np.random.RandomState(7)
+
+        def host_batch(_i):
+            if on_chip:
+                s = host_rng.normal(size=(batch, num_nodes, dim)) \
+                    .astype(np.float32)
+            else:
+                s = host_rng.randint(0, 24, (batch, num_nodes)) \
+                    .astype(np.int32)
+            c = np.cumsum(host_rng.normal(size=(batch, num_nodes, 3)),
+                          axis=1).astype(np.float32)
+            c -= c.mean(axis=1, keepdims=True)
+            return dict(seqs=s, coords=c,
+                        masks=np.ones((batch, num_nodes), bool))
+
+        def run_window(batches_iter):
+            nonlocal params, opt_state, key
+            win_losses = []
+            t0 = time.monotonic()
+            n = 0
+            for b in batches_iter:
+                key, sub = jax.random.split(key)
+                params, opt_state, loss, _ = exec_fn(params, opt_state,
+                                                     b, sub)
+                win_losses.append(loss)
+                n += 1
+            # same window-close semantics as the synchronous bench:
+            # host-materialize the chain tail, then stop the clock
+            last = float(win_losses[-1])
+            fetch_sync(min(jax.tree_util.tree_leaves(params),
+                           key=lambda l: l.size))
+            dt_w = time.monotonic() - t0
+            losses.extend([float(l) for l in win_losses[:-1]] + [last])
+            return batch * num_nodes * n / dt_w
+
+        sync_rates, pipe_rates = [], []
+        for _ in range(2):
+            sync_rates.append(run_window(
+                {k: jnp.asarray(v) for k, v in host_batch(i).items()}
+                for i in range(steps)))
+        stats = PipelineStats(depth=2, capacity=4)
+        for _ in range(2):
+            with BatchProducer((host_batch(i) for i in range(steps)),
+                               capacity=4) as producer:
+                pipe_rates.append(run_window(device_prefetch(
+                    producer, depth=2, stats=stats)))
+        sync_rate = max(sync_rates)
+        window_rates = pipe_rates
+        nodes_steps_per_sec = max(pipe_rates)
+        pipeline_snapshot = stats.snapshot()
+        label += ',pipelined'
     # the CPU liveness-fallback toy keeps its FROZEN single-window
     # definition (round-over-round trend comparability); only chip
     # records get the best-of-two estimator. Gate on on_chip (which
     # selected the program being timed), not the in-process backend —
     # a cpu-probed run can still find an accelerator in process (see
     # the eq-twin guard below) but it measured the TOY workload
-    n_windows = 2 if on_chip else 1
+    n_windows = 0 if pipelined else (2 if on_chip else 1)
     for _ in range(n_windows):
         win_losses = []
         try:
@@ -395,7 +475,8 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
                 raise
             break
 
-    nodes_steps_per_sec = max(window_rates)
+    if not pipelined:
+        nodes_steps_per_sec = max(window_rates)
     dt = batch * num_nodes * steps / nodes_steps_per_sec
 
     # post-window watchdog snapshot: retrace count + device memory
@@ -481,9 +562,13 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
     # each path compares against its own TPU flagship record (different
     # programs); a CPU fallback or batch!=1 run measures a different
     # workload, so comparing would fabricate a regression/speedup
+    # pipelined records measure a different program (per-step host batch
+    # rebuild) — comparing them to the fixed-batch anchors would
+    # fabricate a regression, so they self-compare against their own
+    # sync arm instead (pipelined_vs_sync below)
     ref = FAST_RECORD if fast else RECORD
     vs = nodes_steps_per_sec / ref \
-        if (ref and is_tpu and batch == 1) else 1.0
+        if (ref and is_tpu and batch == 1 and not pipelined) else 1.0
     record = {
         'metric': f'denoise_train_nodes_steps_per_sec_per_chip'
                   f'({label},n={num_nodes},deg={num_degrees},'
@@ -500,6 +585,14 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
         # whose window counts differ
         'steps_trained': len(losses),
     }
+    if pipelined:
+        record['mode'] = 'pipelined'
+        # same payload shape as the schema'd `pipeline` JSONL record:
+        # the proof of where a step's time went travels with the number
+        record['pipeline'] = pipeline_snapshot
+        record['sync_nodes_steps_per_sec'] = round(sync_rate, 2)
+        record['pipelined_vs_sync'] = round(
+            nodes_steps_per_sec / sync_rate, 3)
     if retrace_post_warmup is not None:
         # 0 on a healthy run; >0 means a window paid a recompile and the
         # timing is suspect (the watchdog also warned on stderr)
@@ -564,5 +657,6 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None):
 
 
 if __name__ == '__main__':
+    _pipelined = '--pipelined' in sys.argv[1:]
     _backend, _reason = _device_backend_or_cpu()
-    main(_backend, fallback_reason=_reason)
+    main(_backend, fallback_reason=_reason, pipelined=_pipelined)

@@ -42,6 +42,17 @@ def main():
     ap.add_argument('--flush-every', type=int, default=5,
                     help='telemetry flush interval in optimizer steps '
                          '(one device-to-host sync per flush)')
+    ap.add_argument('--pipelined', action='store_true',
+                    help='overlapped data path (training.pipeline): '
+                         'batches build on a background producer thread, '
+                         'transfer to device --prefetch-depth steps '
+                         'ahead, the per-step batch buffers are donated, '
+                         'and checkpoints write asynchronously; with '
+                         '--telemetry the stream grows host_wait/'
+                         'prefetch phases and schema\'d pipeline records '
+                         '(gate: make pipeline-smoke)')
+    ap.add_argument('--prefetch-depth', type=int, default=2,
+                    help='device-resident batches ahead of the step loop')
     ap.add_argument('--dataset', type=str, default=None,
                     help='train from a PointCloudDataset .npz (see '
                          'training.dataset); --nodes becomes the bucket size')
@@ -58,7 +69,13 @@ def main():
     cfg = DenoiseConfig(num_nodes=args.nodes, batch_size=args.batch,
                         num_degrees=args.degrees, use_mesh=args.mesh,
                         accum_steps=args.accum, telemetry=args.telemetry,
-                        flush_every=args.flush_every)
+                        flush_every=args.flush_every,
+                        pipeline=args.pipelined,
+                        prefetch_depth=args.prefetch_depth,
+                        # every pipelined batch is freshly placed by
+                        # device_prefetch, so donation is safe (see the
+                        # audit in parallel.sharding)
+                        donate_batch=args.pipelined)
     trainer = DenoiseTrainer(cfg)
 
     ckpt = CheckpointManager(args.ckpt_dir) if args.ckpt_dir else None
@@ -74,47 +91,52 @@ def main():
     # context-managed: the file handle closes on EVERY exit path (the old
     # happy-path-only close() leaked it on exceptions)
     with MetricLogger(args.metrics, run_meta=run_meta) as logger:
-        if args.dataset:
-            import itertools
-
-            import jax.numpy as jnp
-            import numpy as np
-
+        if args.pipelined:
+            batch_source = None
+            if args.dataset:
+                from se3_transformer_tpu.training.dataset import (
+                    PointCloudDataset,
+                )
+                from se3_transformer_tpu.training.pipeline import (
+                    dataset_batch_source,
+                )
+                ds = PointCloudDataset.load(args.dataset)
+                batch_source = dataset_batch_source(
+                    ds, batch_size=cfg.batch_size, bucket=cfg.num_nodes,
+                    accum_steps=cfg.accum_steps, num_steps=args.steps)
+            history = trainer.train_pipelined(
+                args.steps, batch_source=batch_source,
+                # without --telemetry the per-step records still land in
+                # --metrics (same shape as the synchronous path)
+                log=lambda msg: logger.log(trainer.step_count, msg=msg),
+                metric_logger=logger if cfg.telemetry else None,
+                checkpoint_manager=ckpt, checkpoint_every=args.ckpt_every)
+        elif args.dataset:
             from se3_transformer_tpu.training.dataset import (
                 PointCloudDataset,
             )
+            from se3_transformer_tpu.training.pipeline import (
+                dataset_batch_source,
+            )
 
             ds = PointCloudDataset.load(args.dataset)
+            # the SAME batch assembly the pipelined path uses: with
+            # accum_steps > 1 each optimizer step accumulates that many
+            # DISTINCT consecutive batches (the reference's 16 distinct
+            # micro-batches, denoise.py:13,55 — the old inline builder
+            # stacked one batch accum times, averaging identical
+            # gradients at accum-times the compute)
+            stream = dataset_batch_source(
+                ds, batch_size=cfg.batch_size, bucket=cfg.num_nodes,
+                accum_steps=cfg.accum_steps)
 
-            def file_batches():
-                for epoch in itertools.count():
-                    yield from ds.batches(batch_size=cfg.batch_size,
-                                          buckets=(cfg.num_nodes,),
-                                          shuffle_seed=epoch)
-
-            def build_batch(stream):
-                b = next(stream)
-                n = b['tokens'].shape[1]
-                batch = dict(
-                    seqs=jnp.asarray(b['tokens']),
-                    coords=jnp.asarray(b['coords']),
-                    masks=jnp.asarray(b['mask']),
-                    adj_mat=jnp.asarray(
-                        np.broadcast_to(b['adj_mat'][None],
-                                        (cfg.batch_size, n, n)).copy()))
-                if cfg.accum_steps > 1:
-                    batch = {k: jnp.stack([v] * cfg.accum_steps)
-                             for k, v in batch.items()}
-                return batch
-
-            stream = file_batches()
             history = []
             for i in range(args.steps):
                 if cfg.telemetry:
                     with trainer.phase_timer.phase('data'):
-                        batch = build_batch(stream)
+                        batch = next(stream)
                 else:
-                    batch = build_batch(stream)
+                    batch = next(stream)
                 loss = trainer.train_step(batch)
                 if cfg.telemetry:
                     # no per-step float(): metrics accumulate on device

@@ -28,7 +28,7 @@ import optax
 from se3_transformer_tpu.models.se3_transformer import SE3TransformerModule
 from se3_transformer_tpu.native import chain_adjacency
 from se3_transformer_tpu.parallel import make_sharded_train_step
-from se3_transformer_tpu.training import BackgroundBatcher, prefetch_to_device
+from se3_transformer_tpu.training import BatchProducer, device_prefetch
 
 NUM_ATOMS = 12
 NUM_TOKENS = 8
@@ -87,8 +87,8 @@ def main():
     opt_state = opt.init(params)
     step = make_sharded_train_step(loss_fn, opt)
 
-    batcher = BackgroundBatcher(build_batch, capacity=4)
-    stream = prefetch_to_device(batcher, size=2)
+    producer = BatchProducer(build_batch, capacity=4)
+    stream = device_prefetch(producer, depth=2)
     key = jax.random.PRNGKey(0)
     first = last = None
     for i in range(args.steps):
@@ -100,7 +100,7 @@ def main():
         last = float(loss)
         if (i + 1) % 10 == 0:
             print(f'step {i + 1}: mse {last:.4f}')
-    batcher.close()
+    producer.close()
     if first is None:
         print('no steps run')
         return

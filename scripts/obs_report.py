@@ -49,6 +49,12 @@ def main(argv=None):
                     help='vs_baseline anchor for telemetry throughput')
     ap.add_argument('--code-rev', default=None,
                     help='only summarize bench records with this code_rev')
+    ap.add_argument('--require-pipeline', action='store_true',
+                    help='gate a pipelined run: exit non-zero unless the '
+                         'stream carries at least one `pipeline` record '
+                         'whose final cumulative counters show at least '
+                         'one prefetch hit (a 100%% stall rate means the '
+                         'pipeline never overlapped anything)')
     args = ap.parse_args(argv)
 
     records = []
@@ -68,6 +74,23 @@ def main(argv=None):
     if not records:
         print('no records found', file=sys.stderr)
         return 1
+
+    if args.require_pipeline:
+        pipes = [r for r in records if r.get('kind') == 'pipeline']
+        if not pipes:
+            print('PIPELINE GATE: no pipeline records in the stream '
+                  '(was the run started with --pipelined?)',
+                  file=sys.stderr)
+            return 1
+        last = pipes[-1].get('prefetch', {})
+        hits, stalls = last.get('hits', 0), last.get('stalls', 0)
+        if not hits:
+            print(f'PIPELINE GATE: 100% prefetch stalls ({stalls} stalls, '
+                  f'0 hits) — the producer never got ahead of the device',
+                  file=sys.stderr)
+            return 1
+        print(f'pipeline gate ok: {hits} hits / {stalls} stalls, '
+              f'verdict {pipes[-1].get("verdict")}', file=sys.stderr)
 
     summary = summarize(records, anchor=args.anchor,
                         code_rev=args.code_rev)

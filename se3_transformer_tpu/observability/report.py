@@ -113,7 +113,7 @@ def summarize_telemetry(records: List[dict],
             continue
         if rid not in runs:
             runs[rid] = dict(meta=None, flushes=[], summary=None,
-                             retrace_warnings=0, steps=[])
+                             retrace_warnings=0, steps=[], pipeline=None)
             order.append(rid)
         kind = rec.get('kind')
         if kind == 'run_meta':
@@ -126,6 +126,9 @@ def summarize_telemetry(records: List[dict],
             runs[rid]['retrace_warnings'] += 1
         elif kind == 'step':
             runs[rid]['steps'].append(rec)
+        elif kind == 'pipeline':
+            # cumulative counters: the last record of the run wins
+            runs[rid]['pipeline'] = rec
 
     out = []
     for rid in order:
@@ -172,6 +175,11 @@ def summarize_telemetry(records: List[dict],
                 rec[k] = summary[k]
         if meta.get('device_kind'):
             rec['device_kind'] = meta['device_kind']
+        if run['pipeline'] is not None:
+            pipe = run['pipeline']
+            rec['pipeline'] = {k: pipe[k] for k in
+                               ('steps', 'queue', 'prefetch', 'verdict')
+                               if k in pipe}
         out.append(rec)
     return out
 

@@ -15,6 +15,13 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    memory), optional nodes_steps_per_sec.
   retrace_warning  a step function retraced after warmup (loud copy of
                    the flush's `retraced` payload).
+  pipeline         one per flush interval (and one at close) of a
+                   pipelined training run (training.pipeline): steps
+                   delivered, queue {capacity, depth_mean}, prefetch
+                   {depth, hits, stalls, hit_rate, host_wait_ms,
+                   place_ms}, and a producer_bound / device_bound /
+                   balanced verdict — the proof of where a step's time
+                   goes (`make pipeline-smoke` gates on it).
   serve            one per serving flush interval (inference subsystem):
                    requests {admitted, served, rejected}, buckets
                    (per-bucket latency {count, p50_ms, p95_ms, p99_ms,
@@ -37,20 +44,27 @@ from typing import Iterable, Union
 
 SCHEMA_VERSION = 1
 
-KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'serve',
-               'summary')
+KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
+               'serve', 'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
     'step': ('run_id', 'step', 't'),
     'flush': ('run_id', 'step', 'window', 'timing', 'runtime'),
     'retrace_warning': ('run_id', 'retraced'),
+    # the verdict (producer_bound / device_bound / balanced) is the
+    # load-bearing field: a pipeline record that cannot say who waited
+    # on whom proves nothing
+    'pipeline': ('run_id', 'steps', 'queue', 'prefetch', 'verdict'),
     # post_warmup_compiles is the load-bearing field of the AOT serving
     # contract (must be 0) — a serve record without it is invalid
     'serve': ('run_id', 'requests', 'buckets', 'runtime', 'queue_depth',
               'post_warmup_compiles'),
     'summary': ('run_id', 'steps', 'metrics', 'timing'),
 }
+
+_PIPELINE_PREFETCH_REQUIRED = ('depth', 'hits', 'stalls')
+_PIPELINE_VERDICTS = ('producer_bound', 'device_bound', 'balanced')
 
 _TIMING_REQUIRED = ('count', 'p50_ms', 'p95_ms', 'max_ms')
 # serving SLOs are quoted at p99 — a serve record without it is invalid
@@ -84,6 +98,19 @@ def validate_record(rec: dict, index=None) -> dict:
             _fail(index, 'run_meta.host must carry hostname and pid')
     if kind == 'step' and not isinstance(rec['step'], int):
         _fail(index, f'step must be an int, got {rec["step"]!r}')
+    if kind == 'pipeline':
+        prefetch = rec['prefetch']
+        missing = [k for k in _PIPELINE_PREFETCH_REQUIRED
+                   if not isinstance(prefetch, dict) or k not in prefetch]
+        if missing:
+            _fail(index, f'pipeline.prefetch missing {missing} '
+                         f'(hit/stall counts are the whole point)')
+        if not isinstance(rec['queue'], dict) \
+                or 'capacity' not in rec['queue']:
+            _fail(index, 'pipeline.queue must carry capacity')
+        if rec['verdict'] not in _PIPELINE_VERDICTS:
+            _fail(index, f'pipeline.verdict {rec["verdict"]!r} not in '
+                         f'{_PIPELINE_VERDICTS}')
     if kind == 'serve':
         requests = rec['requests']
         if not isinstance(requests, dict) or 'served' not in requests \

@@ -95,24 +95,34 @@ def data_specs() -> dict:
     )
 
 
+# the trainer/dataset key names map onto the canonical specs so every
+# batch dict in the repo (DenoiseTrainer's seqs/coords/masks,
+# PointCloudDataset's tokens/mask) places directly through shard_batch /
+# batch_shardings without a rename dance at each call site
+_KEY_ALIASES = dict(seqs='feats', tokens='feats', coords='coors',
+                    masks='mask')
+
+
 def resolve_data_spec(key: str, ndim: int, leading_axes: int = 0) -> P:
     """Canonical PartitionSpec for one batch entry, truncated/padded to its
     rank (shared by shard_batch and distributed.shard_host_local_batch so
     the two placement entry points cannot drift)."""
-    spec = data_specs().get(key, P('dp'))
+    spec = data_specs().get(_KEY_ALIASES.get(key, key), P('dp'))
     spec = P(*([None] * leading_axes), *spec)
     return P(*spec[:ndim]) if ndim < len(spec) else spec
 
 
-def shard_batch(batch: dict, mesh: Mesh, leading_axes: int = 0) -> dict:
-    """Place a host batch dict onto the mesh with the canonical specs.
+def batch_shardings(batch: dict, mesh: Mesh,
+                    leading_axes: int = 0) -> dict:
+    """NamedSharding per batch key, with the divisibility fallback.
 
-    `leading_axes` extra leading dims (e.g. a gradient-accumulation axis)
-    are left unsharded. Axes that do not divide evenly by their mesh axis
-    fall back to replication for that dimension (e.g. batch_size=1 with
-    dp>1), so any batch is placeable — but the fallback is LOUD: silently
-    replicating would make "sharded training" mean "every device does the
-    same work", so each degraded (key, dim) pair warns once."""
+    Axes that do not divide evenly by their mesh axis fall back to
+    replication for that dimension (e.g. batch_size=1 with dp>1), so any
+    batch is placeable — but the fallback is LOUD: silently replicating
+    would make "sharded training" mean "every device does the same
+    work", so each degraded (key, dim) pair warns once. Works on host
+    numpy or device arrays (only shapes are read) — the prefetch
+    pipeline uses it to compute target shardings before transfer."""
     out = {}
     for k, v in batch.items():
         spec = resolve_data_spec(k, v.ndim, leading_axes)
@@ -132,6 +142,16 @@ def shard_batch(batch: dict, mesh: Mesh, leading_axes: int = 0) -> dict:
                         f"does not divide mesh axis '{axis}' (size {size}) "
                         f"— replicating that dimension instead; those "
                         f"devices will do redundant work",
-                        stacklevel=2)
-        out[k] = jax.device_put(v, NamedSharding(mesh, P(*fixed)))
+                        stacklevel=3)
+        out[k] = NamedSharding(mesh, P(*fixed))
     return out
+
+
+def shard_batch(batch: dict, mesh: Mesh, leading_axes: int = 0) -> dict:
+    """Place a host batch dict onto the mesh with the canonical specs.
+
+    `leading_axes` extra leading dims (e.g. a gradient-accumulation axis)
+    are left unsharded. See `batch_shardings` for the divisibility
+    fallback semantics."""
+    shardings = batch_shardings(batch, mesh, leading_axes)
+    return {k: jax.device_put(v, shardings[k]) for k, v in batch.items()}

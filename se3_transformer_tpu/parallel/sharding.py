@@ -11,6 +11,7 @@ collectives rather than a hand-rolled NCCL/MPI layer.
 from __future__ import annotations
 
 import re
+import warnings
 from typing import Callable, Optional
 
 import jax
@@ -21,6 +22,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
+
+
+def _expect_unusable_batch_donation():
+    """Batch leaves can never alias a step output (no output shares
+    their shapes/dtypes — outputs alias the donated params/opt_state),
+    so XLA reports every batch donation 'not usable'. That is expected
+    on the donate_batch path — donation there only marks the buffers
+    dead early — so silence exactly that warning instead of spamming
+    every pipelined compile (docs/PERFORMANCE.md "When donation is
+    safe"). Params/opt_state donations DO alias; a genuine aliasing
+    regression there would surface as a perf/HBM change, not only as
+    this message."""
+    warnings.filterwarnings(
+        'ignore', message='Some donated buffers were not usable')
 
 
 # ---------------------------------------------------------------------- #
@@ -100,6 +115,7 @@ def shard_params(params, mesh: Mesh, axis: str = 'tp'):
 def make_sharded_train_step(loss_fn: Callable, optimizer,
                             mesh: Optional[Mesh] = None,
                             donate: bool = True,
+                            donate_batch: bool = False,
                             tensor_parallel: bool = False,
                             telemetry: bool = False):
     """loss_fn(params, batch, rng) -> (loss, aux). Returns
@@ -117,6 +133,20 @@ def make_sharded_train_step(loss_fn: Callable, optimizer,
     no host sync): step(params, opt_state, batch, rng, acc) ->
     (params, opt_state, loss, aux, acc). The host flushes the
     accumulator once per logging interval.
+
+    Donation audit. `donate=True` donates params/opt_state (and the
+    telemetry accumulator) — always safe: the caller rebinds all three
+    to the step's outputs, and sharded buffers are donated in place so
+    tp-partitioned training resumes/continues without a host round
+    trip; checkpointing snapshots device copies first
+    (`training.checkpoint.snapshot_device_arrays`), so async saves
+    survive the donation too. `donate_batch=True` additionally donates
+    the batch pytree (argnum 2) and is OPT-IN: it is only safe when
+    every batch the step sees is freshly built or freshly placed — the
+    `training.pipeline.device_prefetch` path, or any caller going
+    through `parallel.mesh.shard_batch` (which device_puts fresh
+    arrays per call). A caller that feeds the SAME device batch to two
+    steps must leave it off, or the second step reads deleted buffers.
     """
 
     def step(params, opt_state, batch, rng):
@@ -135,8 +165,12 @@ def make_sharded_train_step(loss_fn: Callable, optimizer,
         return params, opt_state, loss, aux, acc
 
     fn = step_telemetry if telemetry else step
-    # the accumulator is replaced every step — donate it like the state
+    # the accumulator is replaced every step — donate it like the state;
+    # the batch (argnum 2) only on request (see the donation audit above)
     donate_argnums = ((0, 1, 4) if telemetry else (0, 1)) if donate else ()
+    if donate and donate_batch:
+        donate_argnums = tuple(sorted(donate_argnums + (2,)))
+        _expect_unusable_batch_donation()
     if mesh is None:
         return jax.jit(fn, donate_argnums=donate_argnums)
 
@@ -159,6 +193,7 @@ def make_sharded_train_step(loss_fn: Callable, optimizer,
 def make_accumulating_train_step(loss_fn: Callable, optimizer,
                                  accum_steps: int,
                                  mesh: Optional[Mesh] = None,
+                                 donate_batch: bool = False,
                                  tensor_parallel: bool = False,
                                  telemetry: bool = False):
     """Gradient-accumulation variant (reference denoise.py:13,55 uses 16
@@ -168,7 +203,10 @@ def make_accumulating_train_step(loss_fn: Callable, optimizer,
 
     `telemetry=True` threads a MetricAccumulator exactly like
     make_sharded_train_step; the per-micro-step loss VECTOR folds in, so
-    the flushed window's loss min/max expose a diverging micro-batch."""
+    the flushed window's loss min/max expose a diverging micro-batch.
+    `donate_batch=True` donates the stacked micro-batch pytree — same
+    opt-in safety contract as make_sharded_train_step (fresh batch per
+    step only)."""
 
     def _grads_and_losses(params, batch, rng):
         def micro(carry, xs):
@@ -203,6 +241,9 @@ def make_accumulating_train_step(loss_fn: Callable, optimizer,
 
     fn = step_telemetry if telemetry else step
     donate_argnums = (0, 1, 4) if telemetry else (0, 1)
+    if donate_batch:
+        donate_argnums = tuple(sorted(donate_argnums + (2,)))
+        _expect_unusable_batch_donation()
     if mesh is None:
         return jax.jit(fn, donate_argnums=donate_argnums)
     repl = replicated(mesh)

@@ -1,9 +1,12 @@
 from .denoise import (
     DenoiseConfig, DenoiseTrainer, denoise_loss_fn, synthetic_protein_batch,
-    chain_adjacency,
+    synthetic_protein_batch_host, chain_adjacency,
 )
-from .checkpoint import CheckpointManager
-from .data import BackgroundBatcher, prefetch_to_device
+from .checkpoint import CheckpointManager, snapshot_device_arrays
 from .dataset import PointCloudDataset, save_point_cloud_dataset
+from .pipeline import (
+    BatchProducer, BatchProducerError, PipelineStats, dataset_batch_source,
+    device_prefetch,
+)
 from .sidechainnet import convert_sidechainnet
 from .recipes import RECIPES

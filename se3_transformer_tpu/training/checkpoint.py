@@ -4,15 +4,19 @@ The reference has NO model checkpointing (SURVEY.md §5 — denoise.py never
 saves; the only persisted state is the Q_J basis cache). On TPU,
 checkpoint/restore is the recovery story for preemptible slices, so it is
 first-class here: params + optimizer state + step counter, atomic writes,
-latest-checkpoint discovery.
+latest-checkpoint discovery, and an async save path (`save_async`) that
+keeps the step loop dispatching while a background thread serializes.
 """
 from __future__ import annotations
 
 import os
 import pickle
+import re
+import threading
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 try:
@@ -22,12 +26,54 @@ except Exception:  # pragma: no cover - orbax is in the image, but be safe
     _HAS_ORBAX = False
 
 
+# a COMPLETED checkpoint entry: an orbax step dir or a pickle file. An
+# in-flight orbax write lives in `step_N.orbax-checkpoint-tmp-*` and an
+# in-flight pickle in `step_N.pkl.tmp` — neither matches, so a crash
+# mid-write can never surface a partial checkpoint through latest_step.
+_STEP_ENTRY = re.compile(r'^step_(\d+)(\.pkl)?$')
+
+
+def _copy_leaf(x):
+    """A real op (never identity) so jit cannot forward the input buffer
+    to the output: the snapshot must survive a later step donating the
+    original (donate_argnums in parallel.sharding deletes the trainer's
+    params/opt_state arrays on every dispatch)."""
+    if x.dtype == jnp.bool_:
+        return jnp.logical_or(x, False)
+    return x + jnp.zeros((), x.dtype)
+
+
+_snapshot_jit = jax.jit(lambda xs: [_copy_leaf(x) for x in xs])
+
+
+def snapshot_device_arrays(state: Any) -> Any:
+    """Async on-device copy of every jax.Array leaf (other leaves pass
+    through untouched). Dispatches without any host sync — the copies
+    are fresh buffers no later train step can donate, so a writer thread
+    can materialize them at leisure while the step loop keeps running.
+    Sharded arrays keep their placement (GSPMD propagates the input
+    shardings through the copy)."""
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    idx = [i for i, leaf in enumerate(leaves)
+           if isinstance(leaf, jax.Array)]
+    if idx:
+        copies = _snapshot_jit([leaves[i] for i in idx])
+        for i, c in zip(idx, copies):
+            leaves[i] = c
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 class CheckpointManager:
     """Save/restore (params, opt_state, step) under `directory`.
 
     Uses orbax's StandardCheckpointer when available (async-safe, atomic);
     otherwise falls back to atomic pickle-of-numpy files. Either way the
     on-disk layout is step-indexed: <dir>/step_<n>/...
+
+    `save` blocks until the state is durably on disk; `save_async`
+    snapshots the device arrays (without draining the dispatch queue)
+    and writes on a background thread — the next save/save_async/close
+    barriers on the in-flight write and re-raises its failure.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3):
@@ -35,6 +81,8 @@ class CheckpointManager:
         self.max_to_keep = max_to_keep
         os.makedirs(self.directory, exist_ok=True)
         self._ckptr = ocp.StandardCheckpointer() if _HAS_ORBAX else None
+        self._async_thread: Optional[threading.Thread] = None
+        self._async_error: Optional[BaseException] = None
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f'step_{step:08d}')
@@ -42,18 +90,25 @@ class CheckpointManager:
     def all_steps(self):
         steps = []
         for name in os.listdir(self.directory):
-            if name.startswith('step_'):
-                try:
-                    steps.append(int(name[len('step_'):].rstrip('.pkl')))
-                except ValueError:
-                    pass
+            m = _STEP_ENTRY.match(name)
+            if not m:
+                continue
+            path = os.path.join(self.directory, name)
+            # a finalized checkpoint is a directory (orbax) or a .pkl
+            # file; a same-named entry of the other kind is debris
+            if os.path.isfile(path) if m.group(2) else os.path.isdir(path):
+                steps.append(int(m.group(1)))
         return sorted(set(steps))
 
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def save(self, step: int, state: Any):
+    def _write_state(self, step: int, state: Any):
+        """One durable, atomic write (shared by the sync and async
+        paths): orbax writes to a tmp dir and renames at finalize; the
+        pickle fallback writes .pkl.tmp and os.replace()s it — either
+        way `latest_step` only ever sees completed checkpoints."""
         if self._ckptr is not None:
             # hand orbax the jax.Arrays as-is: it writes sharded (even
             # non-fully-addressable multi-host) arrays natively; a
@@ -69,7 +124,71 @@ class CheckpointManager:
             with open(tmp, 'wb') as f:
                 pickle.dump(state, f)
             os.replace(tmp, path)
+
+    def save(self, step: int, state: Any):
+        self.wait_until_finished()
+        self._write_state(step, state)
         self._gc()
+
+    # ------------------------------------------------------------------ #
+    # async save: overlap serialization with training
+    # ------------------------------------------------------------------ #
+    def save_async(self, step: int, state: Any):
+        """Checkpoint without stalling the step loop.
+
+        Dispatches an on-device copy of every jax.Array leaf (async — no
+        host sync, no dispatch-queue drain) and hands the copies to a
+        writer thread that performs the exact same atomic write as
+        `save`. Because the copies are fresh buffers, the caller may
+        keep training immediately — including through steps that donate
+        the original params/opt_state buffers.
+
+        Exactly one write is in flight at a time: a second save/
+        save_async (and `close`/`wait_until_finished`) first joins the
+        previous write and re-raises any failure, so a dying writer
+        can never be silently lost. Multi-host note: like `save`, every
+        process must call this at the same step with its addressable
+        shards.
+        """
+        self.wait_until_finished()
+        snap = snapshot_device_arrays(state)
+
+        def write():
+            try:
+                self._write_state(step, snap)
+                self._gc()
+            except BaseException as e:  # surfaced at the next barrier
+                self._async_error = e
+
+        t = threading.Thread(target=write, name=f'ckpt-write-{step}',
+                             daemon=True)
+        self._async_thread = t
+        t.start()
+
+    @property
+    def save_in_flight(self) -> bool:
+        t = self._async_thread
+        return bool(t is not None and t.is_alive())
+
+    def wait_until_finished(self):
+        """Barrier on the in-flight async write (no-op when idle);
+        re-raises a writer-thread failure."""
+        t, self._async_thread = self._async_thread, None
+        if t is not None:
+            t.join()
+        err, self._async_error = self._async_error, None
+        if err is not None:
+            raise RuntimeError('async checkpoint write failed') from err
+
+    def close(self):
+        self.wait_until_finished()
+
+    def __enter__(self) -> 'CheckpointManager':
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
 
     def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
         """`like` (optional): a pytree matching the saved state. jax.Array

@@ -10,8 +10,8 @@ native C++ batcher. This module provides:
     container (ragged sequences stored flat + offsets): tokens and
     coords; `batches()` attaches the bucket's chain adjacency.
   * `PointCloudDataset.batches(...)` — an iterator of padded, fixed-shape
-    batch dicts grouped by length bucket, ready for `BackgroundBatcher`/
-    `prefetch_to_device`.
+    batch dicts grouped by length bucket, ready for
+    `pipeline.BatchProducer`/`pipeline.device_prefetch`.
 
 Swap in real data (e.g. a sidechainnet export) by writing the same .npz
 layout — no framework changes needed.
@@ -114,6 +114,18 @@ class PointCloudDataset:
         partial batch is dropped for that pass; vary `shuffle_seed` per
         epoch (e.g. pass the epoch number) so different sequences land in
         the remainder each time.
+
+        Thread-handoff contract (training.pipeline.BatchProducer): the
+        batching PLAN — bucket assignment, drop count, and the per-epoch
+        shuffle order — is frozen eagerly, before this call returns. The
+        returned generator closes only over that frozen plan plus the
+        dataset's (treated-as-immutable) flat arrays, so it is safe to
+        hand to a background producer thread while the caller invokes
+        `batches()` again for the next epoch: a live iterator and a
+        re-call share NO mutable epoch state. Each generator is
+        single-consumer (generators are not thread-safe to share); the
+        one instance attribute this method writes, `last_dropped`, is
+        written here — never by the generator.
         """
         buckets = sorted(b for b in buckets
                          if max_len is None or b <= max_len)
@@ -144,12 +156,17 @@ class PointCloudDataset:
 
         rng = np.random.RandomState(shuffle_seed) \
             if shuffle_seed is not None else None
+        # freeze the shuffle order NOW (not lazily at iteration time):
+        # the rng must not be shared between a live iterator and a
+        # re-call, and an eagerly-built plan is what makes the generator
+        # below self-contained enough to run on a producer thread
+        plan = [(buckets[bi],
+                 list(rng.permutation(idxs)) if rng is not None
+                 else list(idxs))
+                for bi, idxs in enumerate(by_bucket)]
 
         def generate() -> Iterator[dict]:
-            for bi, idxs in enumerate(by_bucket):
-                order = list(rng.permutation(idxs)) if rng is not None \
-                    else idxs
-                L = buckets[bi]
+            for L, order in plan:
                 adj = chain_adjacency(L) if with_chain_adjacency else None
                 for start in range(0, len(order) - batch_size + 1,
                                    batch_size):

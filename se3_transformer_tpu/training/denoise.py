@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import functools
 import time
 
 import jax
@@ -72,6 +73,18 @@ class DenoiseConfig:
     # flush one schema'd record every flush_every steps
     telemetry: bool = False
     flush_every: int = 10
+    # overlapped data path (training.pipeline): build batches on a
+    # background producer thread and keep prefetch_depth batches
+    # device-resident ahead of the step loop (train_pipelined)
+    pipeline: bool = False
+    prefetch_depth: int = 2
+    producer_capacity: int = 4
+    # donate the per-step batch buffers to the jitted step. Safe ONLY
+    # when every batch is freshly built/placed (the pipelined path, or
+    # mesh training where shard_batch copies per call) — a caller that
+    # feeds the same device batch twice must leave this off (see the
+    # donation audit in parallel.sharding.make_sharded_train_step)
+    donate_batch: bool = False
 
     def build_module(self) -> SE3TransformerModule:
         return SE3TransformerModule(
@@ -87,22 +100,46 @@ class DenoiseConfig:
 
 
 
-def synthetic_protein_batch(cfg: DenoiseConfig, rng: np.random.RandomState):
-    """Chain-structured point cloud with residue tokens; mimics the
-    backbone-atom layout of the reference's sidechainnet pipeline."""
+@functools.lru_cache(maxsize=64)
+def _chain_adjacency_cached(n: int) -> np.ndarray:
+    """Per-node-count chain adjacency, computed once per process.
+
+    The adjacency of an n-node chain depends only on n, yet the batch
+    builder used to recompute the O(n^2) matrix on EVERY call — pure
+    waste on the producer thread of the pipelined path, where host
+    batch-build time is exactly what the prefetcher is trying to hide.
+    The cached base is marked read-only: every consumer broadcasts or
+    copies it, never mutates it."""
+    adj = chain_adjacency(n)
+    adj.setflags(write=False)
+    return adj
+
+
+def synthetic_protein_batch_host(cfg: DenoiseConfig,
+                                 rng: np.random.RandomState) -> dict:
+    """Host-side (pure numpy) chain-structured point cloud with residue
+    tokens; mimics the backbone-atom layout of the reference's
+    sidechainnet pipeline. This is the producer-thread half of the
+    pipelined data path: no jax calls, so it never contends for the
+    dispatch lock. `adj_mat` is a read-only broadcast view of the cached
+    per-n adjacency — device_put/jnp.asarray copy it on transfer."""
     b, n = cfg.batch_size, cfg.num_nodes
-    seqs = rng.randint(0, cfg.num_tokens, size=(b, n))
+    seqs = rng.randint(0, cfg.num_tokens, size=(b, n)).astype(np.int32)
     # random-walk chain coordinates: consecutive atoms ~bond-length apart
     steps = rng.normal(size=(b, n, 3)).astype(np.float32)
     steps /= np.linalg.norm(steps, axis=-1, keepdims=True)
     coords = np.cumsum(1.5 * steps, axis=1).astype(np.float32)
     coords -= coords.mean(axis=1, keepdims=True)
     masks = np.ones((b, n), dtype=bool)
-    adj = np.broadcast_to(chain_adjacency(n)[None], (b, n, n)).copy()
-    return dict(seqs=jnp.asarray(seqs),
-                coords=jnp.asarray(coords),
-                masks=jnp.asarray(masks),
-                adj_mat=jnp.asarray(adj))
+    adj = np.broadcast_to(_chain_adjacency_cached(n)[None], (b, n, n))
+    return dict(seqs=seqs, coords=coords, masks=masks, adj_mat=adj)
+
+
+def synthetic_protein_batch(cfg: DenoiseConfig, rng: np.random.RandomState):
+    """Device-placed synthetic batch (see synthetic_protein_batch_host
+    for the host half; values are identical)."""
+    return {k: jnp.asarray(v)
+            for k, v in synthetic_protein_batch_host(cfg, rng).items()}
 
 
 def denoise_loss_fn(module: SE3TransformerModule):
@@ -150,11 +187,13 @@ class DenoiseTrainer:
             # reference denoise.py:13,55: 16 micro-batches per update
             self._step_fn = make_accumulating_train_step(
                 self.loss_fn, self.optimizer, cfg.accum_steps,
-                mesh=self.mesh, tensor_parallel=self.tensor_parallel,
+                mesh=self.mesh, donate_batch=cfg.donate_batch,
+                tensor_parallel=self.tensor_parallel,
                 telemetry=cfg.telemetry)
         else:
             self._step_fn = make_sharded_train_step(
                 self.loss_fn, self.optimizer, mesh=self.mesh,
+                donate_batch=cfg.donate_batch,
                 tensor_parallel=self.tensor_parallel,
                 telemetry=cfg.telemetry)
         self.np_rng = np.random.RandomState(cfg.seed)
@@ -203,22 +242,31 @@ class DenoiseTrainer:
             self.opt_state = self.optimizer.init(self.params)
         return self.params
 
-    def train_step(self, batch) -> float:
+    def train_step(self, batch, preplaced: bool = False) -> jax.Array:
         """One optimizer update. With accum_steps > 1 the batch leaves must
-        carry a leading [accum_steps, ...] axis (see micro_batches)."""
+        carry a leading [accum_steps, ...] axis (see micro_batches).
+
+        Returns the DEVICE loss array (a scalar, or the per-micro-step
+        mean with accumulation) — never a Python float: forcing the sync
+        here would stall the dispatch pipeline every step. Callers
+        float() it at their own cadence (`train` does so only at the log
+        interval; the telemetry path never does — metrics accumulate on
+        device and flush per interval).
+
+        `preplaced=True` skips the shard_batch placement: the pipelined
+        path (`train_pipelined` / training.pipeline.device_prefetch)
+        already device_put the batch with the mesh's NamedShardings."""
         if self.params is None:
             init_batch = batch
             if self.cfg.accum_steps > 1:
                 init_batch = jax.tree_util.tree_map(lambda v: v[0], batch)
             self.init(init_batch)
-        if self.mesh is not None:
-            lead = 1 if self.cfg.accum_steps > 1 else 0
-            batch = shard_batch(
-                dict(feats=batch['seqs'], coors=batch['coords'],
-                     mask=batch['masks'], adj_mat=batch['adj_mat']),
-                self.mesh, leading_axes=lead)
-            batch = dict(seqs=batch['feats'], coords=batch['coors'],
-                         masks=batch['mask'], adj_mat=batch['adj_mat'])
+        if self.mesh is not None and not preplaced:
+            # seqs/coords/masks resolve to the canonical feats/coors/mask
+            # specs via parallel.mesh's key aliases
+            batch = shard_batch(batch, self.mesh,
+                                leading_axes=1 if self.cfg.accum_steps > 1
+                                else 0)
         self.rng, sub = jax.random.split(self.rng)
         if self.cfg.telemetry:
             # the step signature differs only by the accumulator pytree;
@@ -254,6 +302,17 @@ class DenoiseTrainer:
             return batches[0]
         return jax.tree_util.tree_map(
             lambda *vs: jnp.stack(vs), *batches)
+
+    def micro_batches_host(self):
+        """Host-side (numpy) counterpart of micro_batches — the default
+        producer-thread batch source for train_pipelined. Same values,
+        same rng stream; the device transfer happens downstream in
+        device_prefetch."""
+        batches = [synthetic_protein_batch_host(self.cfg, self.np_rng)
+                   for _ in range(max(1, self.cfg.accum_steps))]
+        if self.cfg.accum_steps <= 1:
+            return batches[0]
+        return {k: np.stack([b[k] for b in batches]) for k in batches[0]}
 
     # ------------------------------------------------------------------ #
     # telemetry (observability package): flush cadence owned by the host
@@ -339,7 +398,17 @@ class DenoiseTrainer:
         With cfg.telemetry, the per-step float(loss) sync disappears:
         metrics accumulate on device and flush (through `metric_logger`
         when given) every cfg.flush_every steps plus once at the end —
-        history then holds the flush/summary records."""
+        history then holds the flush/summary records.
+
+        With cfg.pipeline, dispatches to `train_pipelined` (synthetic
+        batches built on a producer thread, device prefetch, async
+        checkpoints) — the knob selects the overlapped loop wherever a
+        caller only holds a config."""
+        if self.cfg.pipeline:
+            return self.train_pipelined(
+                num_steps, log=log, checkpoint_manager=checkpoint_manager,
+                checkpoint_every=checkpoint_every,
+                metric_logger=metric_logger)
         history = []
         t0 = time.time()
         micro = max(1, self.cfg.accum_steps)
@@ -382,4 +451,102 @@ class DenoiseTrainer:
                     f'nodes*steps/sec {nodes_per_sec:.1f}{extra}')
         if telemetry:
             history.append(self.telemetry_close(metric_logger))
+        return history
+
+    # ------------------------------------------------------------------ #
+    # overlapped pipeline (training.pipeline): producer thread + device
+    # prefetch + async checkpointing
+    # ------------------------------------------------------------------ #
+    def _pipeline_record(self, stats, metric_logger=None) -> dict:
+        """One schema'd `pipeline` record from the prefetch stats."""
+        fields = stats.snapshot()
+        fields['step'] = self.step_count
+        if metric_logger is not None:
+            return metric_logger.log_record('pipeline', **fields)
+        fields['kind'] = 'pipeline'
+        return fields
+
+    def train_pipelined(self, num_steps: int, batch_source=None, log=print,
+                        checkpoint_manager=None, checkpoint_every: int = 0,
+                        metric_logger=None, async_checkpoint: bool = True):
+        """`train`, with the host taken off the critical path.
+
+        Batches are built on a `BatchProducer` thread (default source:
+        `micro_batches_host` — synthetic host batches; pass any iterator
+        of host batch dicts, e.g. `pipeline.dataset_batch_source`, to
+        train from files), device-placed `cfg.prefetch_depth` steps
+        ahead by `device_prefetch` (honoring the mesh's NamedShardings
+        when the trainer has one), and checkpoints write asynchronously
+        (`CheckpointManager.save_async`) so serialization overlaps the
+        step loop. With cfg.telemetry, flush records grow `host_wait` /
+        `prefetch` phases and every flush interval also emits a
+        `pipeline` record (prefetch hits vs stalls, producer queue
+        depth, producer-bound vs device-bound verdict).
+
+        The batch source is consumed exactly once on the producer thread
+        (single-consumer); source exhaustion ends training early and
+        cleanly, a source exception propagates out of this method."""
+        import itertools
+
+        from .pipeline import BatchProducer, PipelineStats, device_prefetch
+        cfg = self.cfg
+        telemetry = cfg.telemetry
+        if batch_source is None:
+            batch_source = (self.micro_batches_host()
+                            for _ in range(num_steps))
+        place = None
+        if self.mesh is not None:
+            lead = 1 if cfg.accum_steps > 1 else 0
+            mesh = self.mesh
+
+            def place(b):  # noqa: E306 - closure over mesh/lead
+                return shard_batch(b, mesh, leading_axes=lead)
+
+        stats = PipelineStats(depth=cfg.prefetch_depth,
+                              capacity=cfg.producer_capacity)
+        history = []
+        t0 = time.time()
+        micro = max(1, cfg.accum_steps)
+        with BatchProducer(batch_source,
+                           capacity=cfg.producer_capacity) as producer:
+            batches = device_prefetch(
+                producer, depth=cfg.prefetch_depth, sharding=place,
+                phase_timer=self.phase_timer, stats=stats)
+            for i, batch in enumerate(itertools.islice(batches, num_steps)):
+                loss = self.train_step(batch, preplaced=True)
+                if (checkpoint_manager is not None and checkpoint_every > 0
+                        and self.step_count % checkpoint_every == 0):
+                    with (self.phase_timer.phase('checkpoint') if telemetry
+                          else contextlib.nullcontext()):
+                        state = (self.params, self.opt_state,
+                                 self.step_count)
+                        if async_checkpoint and hasattr(checkpoint_manager,
+                                                        'save_async'):
+                            checkpoint_manager.save_async(self.step_count,
+                                                          state)
+                        else:
+                            checkpoint_manager.save(self.step_count, state)
+                if telemetry:
+                    if (i + 1) % cfg.flush_every == 0:
+                        history.append(self.telemetry_flush(metric_logger))
+                        history.append(self._pipeline_record(stats,
+                                                             metric_logger))
+                    continue
+                if (i + 1) % cfg.log_every == 0:
+                    loss = float(loss)  # host sync only at log interval
+                    dt = time.time() - t0
+                    rate = (cfg.batch_size * cfg.num_nodes * micro
+                            * (i + 1)) / dt
+                    history.append(dict(step=self.step_count, loss=loss,
+                                        nodes_steps_per_sec=rate))
+                    log(f'step {self.step_count} loss {loss:.4f} '
+                        f'nodes*steps/sec {rate:.1f} '
+                        f'[pipelined: {stats.hits} hits '
+                        f'{stats.stalls} stalls]')
+        if checkpoint_manager is not None and hasattr(
+                checkpoint_manager, 'wait_until_finished'):
+            checkpoint_manager.wait_until_finished()
+        if telemetry:
+            history.append(self.telemetry_close(metric_logger))
+            history.append(self._pipeline_record(stats, metric_logger))
         return history

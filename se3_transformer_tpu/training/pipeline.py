@@ -1,0 +1,409 @@
+"""Overlapped training data path: producer thread -> device prefetch.
+
+The training hot loop is device-fast but host-bound whenever the host
+builds batches synchronously between step dispatches: the device drains
+its dispatch queue and then idles while numpy assembles the next batch
+and `jnp.asarray` copies it over. This module pipelines the three host
+stages so the device never waits:
+
+  * `BatchProducer`  — runs any host batch source (an iterator, a
+    generator such as `PointCloudDataset.batches`, or a
+    ``build_fn(index) -> batch`` callable) on a background thread behind
+    a BOUNDED queue. Exhaustion terminates the consumer cleanly; an
+    exception in the source is re-raised in the consumer (wrapped as
+    `BatchProducerError` with the original as ``__cause__``).
+  * `device_prefetch` — keeps `depth` batches device-resident ahead of
+    the consumer, issuing `jax.device_put` (honoring a NamedSharding /
+    per-key sharding dict / custom placement callable, so it composes
+    with `parallel.mesh.shard_batch`) for batch N+k while step N
+    computes. `jax.device_put` dispatches asynchronously, so the H2D
+    copy itself overlaps device compute.
+  * `PipelineStats`  — hit/stall accounting for the telemetry package:
+    a *hit* means the consumer's batch was already placed when requested
+    (the device never saw the host), a *stall* means the consumer
+    blocked on the producer. The snapshot is the payload of the schema'd
+    ``pipeline`` JSONL record (observability.schema), whose `verdict`
+    says whether a run is producer-bound or device-bound.
+
+Host wall-clock spent blocked on the producer is recorded into the
+`host_wait` phase of a `PhaseTimer` when one is supplied (and the
+device_put issue time into `prefetch`), so flush records show where a
+step's time goes next to the `step` percentiles.
+
+Buffer-donation contract: every batch that leaves `device_prefetch` is a
+freshly placed device array, so it is safe to donate to the jitted step
+(`make_sharded_train_step(..., donate_batch=True)`) — nothing else holds
+a reference. Callers that reuse a batch across steps must NOT enable
+batch donation (the second step would read deleted buffers).
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional, Union
+
+import jax
+import numpy as np
+
+__all__ = [
+    'BatchProducer', 'BatchProducerError', 'PipelineStats',
+    'dataset_batch_source', 'device_prefetch',
+]
+
+
+class BatchProducerError(RuntimeError):
+    """The batch source raised on the producer thread; the original
+    exception is chained as ``__cause__``."""
+
+
+_DONE = object()     # end-of-source sentinel (also carries errors)
+
+
+class BatchProducer:
+    """Run a host batch source on a background thread behind a bounded
+    queue.
+
+        with BatchProducer(dataset.batches(...), capacity=4) as producer:
+            for batch in device_prefetch(producer, depth=2):
+                ...
+
+    `source` may be an iterable/iterator (consumed once — see
+    `PointCloudDataset.batches` for its single-consumer contract) or a
+    callable ``build_fn(index) -> batch`` (called with 0, 1, 2, ...
+    forever). The queue is bounded by `capacity`, so a fast producer
+    blocks on the slow consumer instead of buffering the whole epoch in
+    host RAM. Single consumer; `close()` (or the context manager) stops
+    the thread and drains the queue.
+    """
+
+    def __init__(self, source: Union[Iterable, Callable[[int], Any]],
+                 capacity: int = 4, name: str = 'batch-producer'):
+        assert capacity >= 1, 'capacity must be >= 1'
+        if callable(source) and not hasattr(source, '__next__') \
+                and not hasattr(source, '__iter__'):
+            build_fn = source      # the genexp body evaluates lazily —
+            source = (build_fn(i) for i in itertools.count())
+        self._it = iter(source)
+        self.capacity = capacity
+        self._q: queue.Queue = queue.Queue(maxsize=capacity)
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._exhausted = False
+        self.puts = 0            # batches the producer finished building
+        self.gets = 0            # batches the consumer received
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    # -- producer thread ------------------------------------------------- #
+    def _put(self, item) -> bool:
+        """Blocking put that honors close(); False if asked to stop."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            for batch in self._it:
+                if not self._put(batch):
+                    return
+                self.puts += 1
+        except BaseException as e:  # re-raised on the consumer side
+            self._error = e
+        finally:
+            self._put(_DONE)
+
+    # -- consumer side --------------------------------------------------- #
+    def ready(self) -> bool:
+        """A batch is available without blocking (used by
+        device_prefetch for hit/stall accounting)."""
+        return not self._q.empty()
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration
+        while True:
+            try:
+                item = self._q.get(timeout=0.5)
+            except queue.Empty:
+                if self._stop.is_set():
+                    raise StopIteration from None
+                if not self._thread.is_alive() and self._q.empty():
+                    # thread died without managing to enqueue the
+                    # sentinel (should not happen; don't hang if it does)
+                    self._exhausted = True
+                    self._raise_or_stop()
+                continue
+            if item is _DONE:
+                self._exhausted = True
+                self._thread.join(timeout=5)
+                self._raise_or_stop()
+            self.gets += 1
+            return item
+
+    def _raise_or_stop(self):
+        if self._error is not None:
+            raise BatchProducerError(
+                'batch source raised on the producer thread'
+            ) from self._error
+        raise StopIteration
+
+    def close(self):
+        """Idempotent: stop the thread, drain the queue, join."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> 'BatchProducer':
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+
+@dataclasses.dataclass
+class PipelineStats:
+    """Hit/stall + occupancy accounting for one prefetch pipeline.
+
+    hit   = the consumer's batch was already device-placed when requested
+    stall = the consumer blocked on the producer (buffer empty)
+
+    `snapshot()` is the payload of the schema'd ``pipeline`` record.
+    """
+    depth: int                   # configured prefetch depth
+    capacity: int = 0            # producer queue capacity (0 = unknown)
+    gets: int = 0                # batches delivered to the consumer
+    hits: int = 0
+    stalls: int = 0
+    host_wait_s: float = 0.0     # total time blocked in next(source)
+    place_s: float = 0.0         # total time issuing device_put
+    occupancy_sum: int = 0       # producer qsize observed at each pull
+    pulls: int = 0
+
+    def record_pull(self, waited_s: float, occupancy: Optional[int]):
+        self.pulls += 1
+        self.host_wait_s += waited_s
+        if occupancy is not None:
+            self.occupancy_sum += occupancy
+
+    def record_get(self, hit: bool):
+        self.gets += 1
+        if hit:
+            self.hits += 1
+        else:
+            self.stalls += 1
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hits / self.gets if self.gets else 0.0
+
+    def verdict(self) -> str:
+        """Where does a step's time go? `device_bound` — the producer was
+        (nearly) always ahead, so the device is the limiter and the
+        pipeline is healthy; `producer_bound` — the consumer mostly
+        blocked on the host, so host batch build is the limiter;
+        `balanced` — in between."""
+        if self.hit_rate >= 0.9:
+            return 'device_bound'
+        if self.hit_rate < 0.5:
+            return 'producer_bound'
+        return 'balanced'
+
+    def snapshot(self) -> dict:
+        return dict(
+            steps=self.gets,
+            queue=dict(
+                capacity=self.capacity,
+                depth_mean=round(self.occupancy_sum / self.pulls, 2)
+                if self.pulls else None),
+            prefetch=dict(
+                depth=self.depth,
+                hits=self.hits,
+                stalls=self.stalls,
+                hit_rate=round(self.hit_rate, 4),
+                host_wait_ms=round(self.host_wait_s * 1e3, 3),
+                place_ms=round(self.place_s * 1e3, 3)),
+            verdict=self.verdict())
+
+
+def _make_placer(sharding) -> Callable[[Any], Any]:
+    """Resolve the `sharding` argument of device_prefetch to a callable.
+
+    None                -> jax.device_put every leaf (default device)
+    a jax Sharding      -> jax.device_put(leaf, sharding) every leaf
+    {key: Sharding}     -> per-key placement for dict batches (keys
+                           missing from the dict fall back to a plain
+                           device_put)
+    callable(batch)     -> used as-is (e.g. a parallel.mesh.shard_batch
+                           closure, which resolves the canonical
+                           NamedSharding per batch key)
+    """
+    if sharding is None:
+        return lambda batch: jax.tree_util.tree_map(jax.device_put, batch)
+    if isinstance(sharding, jax.sharding.Sharding):
+        return lambda batch: jax.tree_util.tree_map(
+            lambda v: jax.device_put(v, sharding), batch)
+    if isinstance(sharding, dict):
+        def place(batch):
+            assert isinstance(batch, dict), (
+                'a {key: Sharding} dict requires dict batches')
+            return {k: jax.device_put(v, sharding[k]) if k in sharding
+                    else jax.device_put(v) for k, v in batch.items()}
+        return place
+    assert callable(sharding), f'unsupported sharding: {type(sharding)}'
+    return sharding
+
+
+def device_prefetch(iterator: Iterable, depth: int = 2, sharding=None,
+                    phase_timer=None, stats: Optional[PipelineStats] = None,
+                    stall_threshold_s: float = 1e-3) -> Iterator:
+    """Keep `depth` batches device-resident ahead of the consumer.
+
+    The H2D copy for batch N+k is issued (asynchronously — device_put
+    does not block) while the device computes step N, so transfer time
+    hides behind compute. With a `BatchProducer` source the top-up is
+    non-blocking while the buffer is non-empty (the producer's `ready()`
+    probe), so a momentarily slow producer delays future batches instead
+    of the one already placed; a plain iterator falls back to one
+    blocking pull per yield (flax-style prefetch) with wait-time
+    thresholding for hit/stall accounting.
+
+    `sharding` is anything `_make_placer` accepts — in particular a
+    NamedSharding or a `shard_batch` closure so SPMD placement happens
+    inside the pipeline. `phase_timer` (observability.PhaseTimer) gets
+    `host_wait` and `prefetch` phase samples; `stats` (PipelineStats)
+    accumulates the ``pipeline`` record payload.
+
+    Yields every batch of `iterator` in order; terminates when the
+    source is exhausted; source exceptions propagate to the consumer.
+    """
+    assert depth >= 1, 'prefetch depth must be >= 1'
+    place = _make_placer(sharding)
+    it = iter(iterator)
+    ready_probe = getattr(iterator, 'ready', None)
+    size_probe = getattr(iterator, 'qsize', None)
+
+    def record_phase(name, seconds):
+        if phase_timer is not None:
+            phase_timer.record(name, seconds)
+
+    def pull():
+        t0 = time.perf_counter()
+        item = next(it)                      # may raise StopIteration
+        waited = time.perf_counter() - t0
+        record_phase('host_wait', waited)
+        if stats is not None:
+            stats.record_pull(
+                waited, size_probe() if size_probe is not None else None)
+        t1 = time.perf_counter()
+        placed = place(item)
+        dt = time.perf_counter() - t1
+        record_phase('prefetch', dt)
+        if stats is not None:
+            stats.place_s += dt
+        return placed
+
+    def gen():
+        buf = collections.deque()
+        exhausted = False
+        while True:
+            stalled = False
+            while not exhausted and len(buf) < depth:
+                if buf and ready_probe is not None and not ready_probe():
+                    break        # don't block a ready batch on a future one
+                empty = not buf
+                if empty:
+                    # the consumer is genuinely waiting on the host; it
+                    # still counts as a hit when the producer had the
+                    # batch ready (probe), or — for probe-less sources —
+                    # when the pull returned near-instantly
+                    was_ready = ready_probe() if ready_probe is not None \
+                        else None
+                    t0 = time.perf_counter()
+                try:
+                    buf.append(pull())
+                except StopIteration:
+                    exhausted = True
+                    continue
+                if empty:
+                    stalled = (not was_ready) if was_ready is not None \
+                        else (time.perf_counter() - t0 >= stall_threshold_s)
+            if not buf:
+                return
+            if stats is not None:
+                stats.record_get(hit=not stalled)
+            yield buf.popleft()
+
+    return gen()
+
+
+def dataset_batch_source(dataset, batch_size: int, bucket: int,
+                         accum_steps: int = 1,
+                         num_steps: Optional[int] = None,
+                         num_tokens_dtype=np.int32) -> Iterator[dict]:
+    """Host batch dicts for `DenoiseTrainer` from a `PointCloudDataset`.
+
+    Cycles epochs forever (per-epoch shuffle seed = epoch number, so the
+    dropped remainder rotates), renames dataset keys to the trainer's
+    (tokens->seqs, mask->masks), broadcasts the bucket's chain adjacency
+    to [batch, n, n], and — with accum_steps > 1 — stacks that many
+    consecutive batches on a leading axis. Pure numpy: meant to run
+    entirely on a `BatchProducer` thread. Stops after `num_steps` outer
+    steps (None = infinite).
+    """
+    assert len(dataset), 'empty dataset'
+
+    def host_batch(b):
+        n = b['tokens'].shape[1]
+        adj = np.broadcast_to(b['adj_mat'][None], (batch_size, n, n))
+        return dict(seqs=b['tokens'].astype(num_tokens_dtype),
+                    coords=b['coords'], masks=b['mask'], adj_mat=adj)
+
+    def gen():
+        produced = 0
+        micro = []
+        for epoch in itertools.count():
+            got = False
+            for b in dataset.batches(batch_size=batch_size,
+                                     buckets=(bucket,),
+                                     shuffle_seed=epoch):
+                got = True
+                micro.append(host_batch(b))
+                if len(micro) < max(1, accum_steps):
+                    continue
+                if accum_steps <= 1:
+                    out = micro[0]
+                else:
+                    out = {k: np.stack([m[k] for m in micro])
+                           for k in micro[0]}
+                micro.clear()
+                yield out
+                produced += 1
+                if num_steps is not None and produced >= num_steps:
+                    return
+            if not got:
+                raise ValueError(
+                    f'dataset produced no full batches for bucket '
+                    f'{bucket} at batch_size {batch_size} — nothing '
+                    f'to train on')
+
+    return gen()

@@ -93,6 +93,13 @@ _HEAVY_TESTS = {
     'test_toy_keeps_frozen_single_window',
     'test_record_schema',
     'test_rate_consistent_with_step_ms',
+    # pipeline tier (PR 3): the trainer-backed pipeline tests compile
+    # the denoise model (re-measure with --durations after re-tiering)
+    'test_donated_batch_matches_non_donated_and_resumes',
+    'test_save_async_does_not_block_and_overlaps_training',
+    'test_train_pipelined_telemetry_stream_valid',
+    'test_train_pipelined_stops_on_source_exhaustion',
+    'test_save_async_roundtrip_bit_exact',
 }
 
 

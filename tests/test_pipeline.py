@@ -1,0 +1,398 @@
+"""Overlapped-pipeline tests: producer ordering/termination/error
+propagation, device prefetch (order, sharding, hit/stall accounting),
+async checkpoint crash-safety and overlap, donated-buffer parity, and
+the schema'd pipeline record."""
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from se3_transformer_tpu.training import (
+    BatchProducer, BatchProducerError, CheckpointManager, DenoiseConfig,
+    DenoiseTrainer, PipelineStats, device_prefetch,
+)
+
+
+def _tiny_cfg(**kw):
+    # depth=1: halves the compile cost of every trainer-based test here
+    # (the pipeline machinery under test is model-size-agnostic)
+    base = dict(num_nodes=16, batch_size=1, num_degrees=2, depth=1,
+                max_sparse_neighbors=4, learning_rate=1e-3)
+    base.update(kw)
+    return DenoiseConfig(**base)
+
+
+# --------------------------------------------------------------------- #
+# producer + prefetch
+# --------------------------------------------------------------------- #
+def test_producer_preserves_order_and_terminates():
+    src = ({'x': np.full((2, 2), i, np.float32)} for i in range(9))
+    with BatchProducer(src, capacity=3) as producer:
+        seen = [int(b['x'][0, 0]) for b in producer]
+    assert seen == list(range(9))
+    # exhausted producer stays exhausted (no hang, no restart)
+    with pytest.raises(StopIteration):
+        next(producer)
+
+
+def test_producer_build_fn_and_close_mid_stream():
+    producer = BatchProducer(lambda i: {'i': i}, capacity=2)
+    assert [next(producer)['i'] for _ in range(4)] == [0, 1, 2, 3]
+    producer.close()          # infinite source: close() must not hang
+    producer.close()          # idempotent
+
+
+def test_producer_propagates_source_exception():
+    def source():
+        for i in range(3):
+            yield {'i': i}
+        raise ValueError('boom at 3')
+
+    with BatchProducer(source(), capacity=2) as producer:
+        got = [next(producer)['i'] for _ in range(3)]
+        assert got == [0, 1, 2]
+        with pytest.raises(BatchProducerError) as err:
+            next(producer)
+    assert isinstance(err.value.__cause__, ValueError)
+
+
+def test_prefetch_preserves_order_and_terminates():
+    src = ({'x': np.full((2,), i, np.float32)} for i in range(7))
+    stats = PipelineStats(depth=2, capacity=3)
+    with BatchProducer(src, capacity=3) as producer:
+        out = list(device_prefetch(producer, depth=2, stats=stats))
+    assert [int(np.asarray(b['x'])[0]) for b in out] == list(range(7))
+    # everything is device-placed
+    assert all(isinstance(b['x'], jax.Array) for b in out)
+    assert stats.gets == 7
+    assert stats.hits + stats.stalls == 7
+    snap = stats.snapshot()
+    assert snap['verdict'] in ('producer_bound', 'device_bound', 'balanced')
+
+
+def test_prefetch_propagates_source_exception():
+    def source():
+        yield {'x': np.zeros((2,), np.float32)}
+        raise RuntimeError('died')
+
+    with BatchProducer(source(), capacity=2) as producer:
+        it = device_prefetch(producer, depth=2)
+        with pytest.raises(BatchProducerError):
+            list(it)
+
+
+def test_prefetch_plain_iterator_and_empty_source():
+    # no producer thread at all: a bare generator still works (flax-style
+    # blocking top-up, wait-threshold hit accounting)
+    out = list(device_prefetch(({'x': np.full((2,), i)} for i in range(4)),
+                               depth=3))
+    assert [int(np.asarray(b['x'])[0]) for b in out] == [0, 1, 2, 3]
+    assert list(device_prefetch(iter(()), depth=2)) == []
+
+
+def test_prefetch_honors_named_sharding():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from se3_transformer_tpu.parallel import make_mesh
+    from se3_transformer_tpu.parallel.mesh import shard_batch
+
+    mesh = make_mesh(dp=2, sp=4, tp=1)
+    src = (dict(seqs=np.zeros((2, 8), np.int32),
+                coords=np.zeros((2, 8, 3), np.float32),
+                masks=np.ones((2, 8), bool)) for _ in range(3))
+
+    def place(b):
+        return shard_batch(b, mesh)
+
+    out = list(device_prefetch(src, depth=2, sharding=place))
+    assert len(out) == 3
+    # the trainer keys resolve to the canonical dp/sp specs via the
+    # parallel.mesh key aliases
+    assert out[0]['seqs'].sharding == NamedSharding(mesh, P('dp', 'sp'))
+    assert out[0]['coords'].sharding == NamedSharding(
+        mesh, P('dp', 'sp', None))
+
+    # a single Sharding replicates every leaf
+    repl = NamedSharding(mesh, P())
+    out2 = list(device_prefetch(
+        (dict(x=np.zeros((4,), np.float32)) for _ in range(2)),
+        depth=1, sharding=repl))
+    assert out2[0]['x'].sharding == repl
+
+
+def test_prefetch_records_host_phases():
+    from se3_transformer_tpu.observability import PhaseTimer
+    timer = PhaseTimer()
+    src = ({'x': np.zeros((2,), np.float32)} for _ in range(5))
+    list(device_prefetch(src, depth=2, phase_timer=timer))
+    summary = timer.window_summary()
+    assert summary['host_wait']['count'] == 5
+    assert summary['prefetch']['count'] == 5
+
+
+# --------------------------------------------------------------------- #
+# cached adjacency + host/device batch parity
+# --------------------------------------------------------------------- #
+def test_synthetic_batch_host_device_parity_and_cached_adjacency():
+    from se3_transformer_tpu.training.denoise import (
+        _chain_adjacency_cached, synthetic_protein_batch,
+        synthetic_protein_batch_host,
+    )
+    cfg = _tiny_cfg(batch_size=2)
+    host = synthetic_protein_batch_host(cfg, np.random.RandomState(5))
+    dev = synthetic_protein_batch(cfg, np.random.RandomState(5))
+    for k in ('seqs', 'coords', 'masks', 'adj_mat'):
+        np.testing.assert_array_equal(np.asarray(dev[k]), host[k]), k
+    # the adjacency base is computed once per n and shared read-only
+    a = _chain_adjacency_cached(cfg.num_nodes)
+    assert a is _chain_adjacency_cached(cfg.num_nodes)
+    assert not a.flags.writeable
+    i = np.arange(cfg.num_nodes)
+    np.testing.assert_array_equal(
+        a, np.abs(i[:, None] - i[None, :]) == 1)
+
+
+def test_dataset_batches_iterators_are_independent(tmp_path):
+    """The batching plan freezes at call time: a live iterator and a
+    re-call share no mutable epoch state, so interleaved consumption
+    (the producer-thread handoff pattern) yields identical streams."""
+    from se3_transformer_tpu.training.dataset import (
+        PointCloudDataset, save_point_cloud_dataset,
+    )
+    rng = np.random.RandomState(0)
+    lengths = [10, 12, 14, 9, 11, 13]
+    toks = [rng.randint(0, 24, L) for L in lengths]
+    crds = [rng.normal(size=(L, 3)).astype(np.float32) for L in lengths]
+    path = save_point_cloud_dataset(str(tmp_path / 'ds'), toks, crds)
+    ds = PointCloudDataset.load(path)
+
+    it_a = ds.batches(batch_size=2, buckets=(16,), shuffle_seed=3)
+    it_b = ds.batches(batch_size=2, buckets=(16,), shuffle_seed=3)
+    a_first = next(it_a)
+    # consuming B fully must not perturb the already-created A
+    b_all = list(it_b)
+    a_all = [a_first] + list(it_a)
+    assert len(a_all) == len(b_all) == 3
+    for a, b in zip(a_all, b_all):
+        np.testing.assert_array_equal(a['tokens'], b['tokens'])
+        np.testing.assert_array_equal(a['coords'], b['coords'])
+
+
+# --------------------------------------------------------------------- #
+# async checkpointing
+# --------------------------------------------------------------------- #
+def test_save_async_roundtrip_bit_exact(tmp_path):
+    mgr = CheckpointManager(os.path.join(tmp_path, 'ck'))
+    state = {'w': jnp.asarray(np.random.RandomState(0)
+                              .normal(size=(16, 8)).astype(np.float32)),
+             'n': jnp.asarray(3, jnp.int32),
+             'flag': jnp.ones((4,), bool),
+             'step': 7}
+    expect = jax.device_get(state)
+    mgr.save_async(7, state)
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 7
+    restored = mgr.restore()
+    for k in ('w', 'n', 'flag'):
+        np.testing.assert_array_equal(np.asarray(restored[k]),
+                                      np.asarray(expect[k]))
+
+
+def test_save_async_survives_donation_of_original(tmp_path):
+    """The on-device snapshot is taken before save_async returns, so the
+    caller may immediately donate (delete) the original buffers."""
+    mgr = CheckpointManager(os.path.join(tmp_path, 'ck'))
+    x = jnp.arange(64, dtype=jnp.float32)
+    expect = np.asarray(x).copy()
+
+    bump = jax.jit(lambda v: v + 1, donate_argnums=(0,))
+    mgr.save_async(1, {'x': x})
+    _ = bump(x)     # donates/deletes x (a no-op warning on CPU is fine)
+    del x
+    mgr.wait_until_finished()
+    np.testing.assert_array_equal(np.asarray(mgr.restore()['x']), expect)
+
+
+def test_save_async_does_not_block_and_overlaps_training(tmp_path):
+    """Dispatching N steps while a save is in flight never blocks on the
+    writer thread; the checkpoint that lands restores bit-exact."""
+    mgr = CheckpointManager(os.path.join(tmp_path, 'ck'))
+    gate = threading.Event()
+    inner = mgr._write_state
+
+    def slow_write(step, state):
+        assert gate.wait(timeout=30), 'writer gate never opened'
+        inner(step, state)
+
+    mgr._write_state = slow_write
+
+    cfg = _tiny_cfg()
+    trainer = DenoiseTrainer(cfg)
+    rng = np.random.RandomState(0)
+    from se3_transformer_tpu.training import synthetic_protein_batch
+    trainer.train_step(synthetic_protein_batch(cfg, rng))
+
+    state = (trainer.params, trainer.opt_state, trainer.step_count)
+    # deep copy, NOT device_get: on the CPU backend device_get returns
+    # zero-copy VIEWS, and the donating train steps below overwrite the
+    # donated param buffers in place — a view would mutate under us
+    expect = jax.tree_util.tree_map(
+        lambda x: np.array(x, copy=True), state)
+    mgr.save_async(trainer.step_count, state)
+    assert mgr.save_in_flight
+
+    # the step loop keeps going while the writer is gated shut
+    for _ in range(3):
+        trainer.train_step(synthetic_protein_batch(cfg, rng))
+    assert mgr.save_in_flight, 'writer finished while gated?'
+    assert trainer.step_count == 4
+
+    gate.set()
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 1
+    # `state`'s original leaves were donated by the later steps; the
+    # snapshot the writer persisted must still restore bit-exact
+    restored = mgr.restore(like=expect)
+    for a, b in zip(jax.tree_util.tree_leaves(expect),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_save_async_partial_writes_invisible_to_latest_step(tmp_path):
+    """Crash-safety: in-progress debris (orbax tmp dirs, .pkl.tmp files,
+    or a bare unfinished entry of the wrong kind) never surfaces through
+    all_steps/latest_step."""
+    d = os.path.join(tmp_path, 'ck')
+    mgr = CheckpointManager(d)
+    mgr.save(3, {'x': jnp.ones((4,))})
+    assert mgr.latest_step() == 3
+    # simulate crashes mid-write, all with LARGER step numbers
+    os.makedirs(os.path.join(d, 'step_00000008.orbax-checkpoint-tmp-123'))
+    with open(os.path.join(d, 'step_00000009.pkl.tmp'), 'wb') as f:
+        f.write(b'partial')
+    # a step_N *file* (orbax writes dirs) / step_N.pkl *dir* are debris too
+    with open(os.path.join(d, 'step_00000010'), 'wb') as f:
+        f.write(b'junk')
+    os.makedirs(os.path.join(d, 'step_00000011.pkl'))
+    assert mgr.all_steps() == [3]
+    assert mgr.latest_step() == 3
+
+
+def test_save_async_writer_failure_surfaces_at_barrier(tmp_path):
+    mgr = CheckpointManager(os.path.join(tmp_path, 'ck'))
+
+    def bad_write(step, state):
+        raise IOError('disk on fire')
+
+    mgr._write_state = bad_write
+    mgr.save_async(1, {'x': jnp.ones((2,))})
+    with pytest.raises(RuntimeError, match='async checkpoint write'):
+        mgr.wait_until_finished()
+    # the error is consumed: the manager is usable again
+    mgr.wait_until_finished()
+
+
+# --------------------------------------------------------------------- #
+# donation audit
+# --------------------------------------------------------------------- #
+def test_donated_batch_matches_non_donated_and_resumes(tmp_path):
+    """donate_batch changes buffer lifetime, never math: same seed, same
+    stream of fresh batches -> bit-identical params; and a checkpoint
+    saved mid-run on the donated path restores and continues."""
+    def run(donate):
+        cfg = _tiny_cfg(donate_batch=donate, seed=11)
+        trainer = DenoiseTrainer(cfg)
+        rng = np.random.RandomState(2)
+        from se3_transformer_tpu.training import synthetic_protein_batch
+        for _ in range(3):
+            # a FRESH batch each step: the only regime where batch
+            # donation is legal (see parallel.sharding donation audit)
+            trainer.train_step(synthetic_protein_batch(cfg, rng))
+        return trainer
+
+    a, b = run(donate=False), run(donate=True)
+    for x, y in zip(jax.tree_util.tree_leaves(a.params),
+                    jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    # checkpoint-resume on the donated path is bit-exact vs continuing
+    mgr = CheckpointManager(os.path.join(tmp_path, 'ck'))
+    mgr.save_async(b.step_count, (b.params, b.opt_state, b.step_count))
+    mgr.wait_until_finished()
+    cfg2 = _tiny_cfg(donate_batch=True, seed=11)
+    resumed = DenoiseTrainer(cfg2)
+    resumed.init()
+    state = mgr.restore(like=(resumed.params, resumed.opt_state, 0))
+    resumed.params, resumed.opt_state, resumed.step_count = state
+
+    rng_a = np.random.RandomState(9)
+    rng_b = np.random.RandomState(9)
+    from se3_transformer_tpu.training import synthetic_protein_batch
+    b.rng = jax.random.PRNGKey(99)
+    resumed.rng = jax.random.PRNGKey(99)
+    la = b.train_step(synthetic_protein_batch(cfg2, rng_a))
+    lb = resumed.train_step(synthetic_protein_batch(cfg2, rng_b))
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# --------------------------------------------------------------------- #
+# pipelined trainer end to end + the pipeline record
+# --------------------------------------------------------------------- #
+def test_train_pipelined_telemetry_stream_valid(tmp_path):
+    from se3_transformer_tpu.observability import MetricLogger
+    from se3_transformer_tpu.observability.schema import validate_stream
+
+    path = os.path.join(tmp_path, 'pipe.jsonl')
+    cfg = _tiny_cfg(telemetry=True, flush_every=2, pipeline=True,
+                    donate_batch=True)
+    trainer = DenoiseTrainer(cfg)
+    mgr = CheckpointManager(os.path.join(tmp_path, 'ck'))
+    with MetricLogger(path, mirror=None) as logger:
+        history = trainer.train_pipelined(
+            5, metric_logger=logger, checkpoint_manager=mgr,
+            checkpoint_every=2)
+    assert trainer.step_count == 5
+    assert mgr.latest_step() == 4
+
+    info = validate_stream(path)
+    assert info['kinds']['pipeline'] >= 2      # per-flush + close
+    recs = [json.loads(l) for l in open(path)]
+    pipes = [r for r in recs if r['kind'] == 'pipeline']
+    final = pipes[-1]
+    assert final['steps'] == 5
+    assert final['prefetch']['hits'] + final['prefetch']['stalls'] == 5
+    assert final['verdict'] in ('producer_bound', 'device_bound',
+                                'balanced')
+    # flush records carry the new host phases
+    flushes = [r for r in recs if r['kind'] == 'flush']
+    assert any('host_wait' in f['timing'] for f in flushes)
+    # loss trajectory sane
+    summary = [r for r in recs if r['kind'] == 'summary'][-1]
+    assert np.isfinite(summary['loss_last'])
+
+
+def test_train_pipelined_stops_on_source_exhaustion():
+    cfg = _tiny_cfg()
+    trainer = DenoiseTrainer(cfg)
+    source = (trainer.micro_batches_host() for _ in range(2))
+    history = trainer.train_pipelined(10, batch_source=source,
+                                      log=lambda *_: None)
+    assert trainer.step_count == 2     # ended early, cleanly
+
+
+def test_pipeline_record_schema_rejects_bad_verdict():
+    from se3_transformer_tpu.observability.schema import (
+        SchemaError, validate_record,
+    )
+    good = dict(kind='pipeline', run_id='r', steps=3,
+                queue=dict(capacity=4),
+                prefetch=dict(depth=2, hits=3, stalls=0), verdict='balanced')
+    validate_record(good)
+    with pytest.raises(SchemaError, match='verdict'):
+        validate_record({**good, 'verdict': 'vibes'})
+    with pytest.raises(SchemaError, match='prefetch'):
+        validate_record({**good, 'prefetch': {'depth': 2}})

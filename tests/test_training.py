@@ -106,17 +106,14 @@ def test_metric_logger(tmp_path):
 
 
 def test_background_batcher_and_prefetch():
-    from se3_transformer_tpu.training.data import (
-        BackgroundBatcher, prefetch_to_device,
-    )
-    batcher = BackgroundBatcher(
-        lambda i: {'x': np.full((2, 3), i, np.float32)}, capacity=2)
-    seen = []
-    it = prefetch_to_device(batcher, size=2)
-    for _ in range(5):
-        b = next(it)
-        seen.append(float(np.asarray(b['x'])[0, 0]))
-    batcher.close()
+    # training.pipeline superseded the old training.data pair; same
+    # contract: build_fn(index) source, in-order distinct batches
+    from se3_transformer_tpu.training import BatchProducer, device_prefetch
+    with BatchProducer(
+            lambda i: {'x': np.full((2, 3), i, np.float32)},
+            capacity=2) as producer:
+        it = device_prefetch(producer, depth=2)
+        seen = [float(np.asarray(next(it)['x'])[0, 0]) for _ in range(5)]
     assert seen == sorted(seen)  # in order
     assert len(set(seen)) == 5   # distinct batches
 

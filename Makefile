@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke pipeline-smoke clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke pipeline-smoke tune-smoke clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -37,6 +37,12 @@ pipeline-smoke:    ## 6-step pipelined CPU denoise (docs/PERFORMANCE.md): exits 
 	rm -f /tmp/pipeline_smoke.jsonl
 	python denoise.py --steps 6 --nodes 48 --accum 2 --cpu --pipelined --telemetry --flush-every 3 --metrics /tmp/pipeline_smoke.jsonl
 	python scripts/obs_report.py /tmp/pipeline_smoke.jsonl --validate --require-pipeline --out /tmp/pipeline_smoke_summary.json
+
+tune-smoke:        ## interpret-mode kernel-autotuner mini-sweep on CPU (docs/PERFORMANCE.md "Kernel tuning"): exits non-zero unless the tune records are schema-valid AND a promoted entry is consulted on the next pick
+	rm -rf /tmp/tune_smoke_cache /tmp/tune_smoke.jsonl
+	SE3_TPU_CACHE_PATH=/tmp/tune_smoke_cache python scripts/tune_kernels.py --smoke --dry-run --max-targets 2 --out /tmp/tune_smoke.jsonl
+	SE3_TPU_CACHE_PATH=/tmp/tune_smoke_cache python scripts/tune_kernels.py --smoke --max-targets 1 --max-candidates 1 --pairs 1 --steps 2 --margin -1 --out /tmp/tune_smoke.jsonl
+	python scripts/obs_report.py /tmp/tune_smoke.jsonl --validate --require-tune --out /tmp/tune_smoke_summary.json
 
 tpu-checks:        ## on-chip equivariance + kernel numerics/speed gate
 	python scripts/tpu_checks.py

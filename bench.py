@@ -221,6 +221,19 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None,
 
     enable_compilation_cache()
 
+    # kernel-tuning consult delta: which block picks this record's
+    # executables resolved from the measured table vs the heuristic
+    # (kernels/tuning.py). Snapshot/delta, not reset: an in-process
+    # session (tpu_session) runs several stages off one consult log.
+    # The kernel jit caches must be dropped first: picks resolve at
+    # trace time, so a kernel already traced by an earlier stage (e.g.
+    # the tune stage's adoption proof) would reuse its executable and
+    # record NOTHING here — a record benched under tuned blocks
+    # masquerading as consult-free.
+    from se3_transformer_tpu.kernels import tuning as kernel_tuning
+    kernel_tuning.clear_kernel_caches()
+    tuning_snap = kernel_tuning.snapshot()
+
     if on_chip:
         # the tracked config (BASELINE.md): SE3Transformer flagship at
         # 1024 nodes, num_degrees=4, kNN k=32. dim=64 is the max width
@@ -584,7 +597,22 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None,
         # windows complete) — keeps loss_last comparable across rounds
         # whose window counts differ
         'steps_trained': len(losses),
+        # the estimator, explicit (ADVICE r5 #1): cross-round comparisons
+        # must never infer it from len(window_rates)
+        'timing': ('best-of-2' if len(window_rates) >= 2
+                   else 'single-window-truncated')
+        if (on_chip or pipelined) else 'frozen-toy',
     }
+    try:
+        # adopted-vs-heuristic block picks travel with the number: a
+        # record benched under a tuned table entry must never be read as
+        # a heuristic-pick measurement (kernels/tuning.py)
+        record['kernel_tuning'] = kernel_tuning.consult_summary(
+            kernel_tuning.consults_since(tuning_snap))
+    except Exception as e:  # noqa: BLE001 - diagnostics must not lose
+        # the timing already measured
+        print(f'kernel tuning summary failed ({type(e).__name__}: {e})',
+              file=sys.stderr)
     if pipelined:
         record['mode'] = 'pipelined'
         # same payload shape as the schema'd `pipeline` JSONL record:

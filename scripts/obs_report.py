@@ -49,6 +49,12 @@ def main(argv=None):
                     help='vs_baseline anchor for telemetry throughput')
     ap.add_argument('--code-rev', default=None,
                     help='only summarize bench records with this code_rev')
+    ap.add_argument('--require-tune', action='store_true',
+                    help='gate a kernel-tuning run (make tune-smoke): '
+                         'exit non-zero unless the stream carries at '
+                         'least one `tune` record, at least one '
+                         'promotion, and a `consulted` verdict proving '
+                         'the promoted entry steered the next pick')
     ap.add_argument('--require-pipeline', action='store_true',
                     help='gate a pipelined run: exit non-zero unless the '
                          'stream carries at least one `pipeline` record '
@@ -91,6 +97,26 @@ def main(argv=None):
             return 1
         print(f'pipeline gate ok: {hits} hits / {stalls} stalls, '
               f'verdict {pipes[-1].get("verdict")}', file=sys.stderr)
+
+    if args.require_tune:
+        tunes = [r for r in records if r.get('kind') == 'tune']
+        if not tunes:
+            print('TUNE GATE: no tune records in the stream (was '
+                  'scripts/tune_kernels.py run?)', file=sys.stderr)
+            return 1
+        promoted = [r for r in tunes if r.get('verdict') == 'promoted']
+        consulted = [r for r in tunes if r.get('verdict') == 'consulted']
+        if not promoted:
+            print('TUNE GATE: no candidate was promoted', file=sys.stderr)
+            return 1
+        if not consulted:
+            print('TUNE GATE: no consulted verdict — the promoted entry '
+                  'was never proven to steer a subsequent pick',
+                  file=sys.stderr)
+            return 1
+        print(f'tune gate ok: {len(tunes)} tune records, '
+              f'{len(promoted)} promoted, {len(consulted)} consulted',
+              file=sys.stderr)
 
     summary = summarize(records, anchor=args.anchor,
                         code_rev=args.code_rev)

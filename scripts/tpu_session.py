@@ -340,35 +340,22 @@ def main():
         default (the 7 MiB picker's (512,16)) vs the pre-adoption
         (512,8). The kernel jit wrappers' caches are cleared between
         arms — the env override is read at trace time, so a stale traced
-        kernel would silently measure the same program twice
-        (kernel_tune.py learned this the hard way)."""
+        kernel would silently measure the same program twice (the
+        retired kernel_tune.py sweep learned this the hard way)."""
         import json
         import bench
-        from se3_transformer_tpu.kernels import pallas_pairwise as pp
-
-        def clear_kernel_caches():
-            cleared = 0
-            for nm in ('fused_pairwise_conv', 'fused_pairwise_conv_bx',
-                       'fused_pairwise_conv_bxf', 'fused_pairwise_conv_bwd'):
-                f = getattr(pp, nm, None)
-                if f is not None and hasattr(f, 'clear_cache'):
-                    f.clear_cache()
-                    cleared += 1
-            for nm in ('_fwd_partitioned', '_bx_partitioned',
-                       '_bxf_partitioned', '_bwd_partitioned'):
-                f = getattr(pp, nm, None)
-                if f is not None and hasattr(f, 'cache_clear'):
-                    f.cache_clear()
-                    cleared += 1
-            if cleared == 0:
-                # a silent no-op would let both arms reuse arm 1's traced
-                # kernel and bank a pair that compared identical programs
-                raise RuntimeError(
-                    'clear_kernel_caches cleared nothing — jit wrapper '
-                    'cache API changed; block A/B would be invalid')
+        # the shared helper (also used by bench/engine/tune_kernels)
+        # clears the attention caches too — a local subset copy would
+        # drift exactly the way the round-4 helpers review called out
+        from se3_transformer_tpu.kernels.tuning import clear_kernel_caches
 
         path = os.path.join(os.path.dirname(here), 'BLOCK_AB.jsonl')
-        arms = [('default_512_16', {}),
+        # BOTH arms pinned via env override (the highest-priority path):
+        # with the measured table now in front of the heuristic, an
+        # unpinned "default" arm would silently measure whatever entry a
+        # previous tune stage promoted — mislabeling the A/B
+        arms = [('default_512_16', {'SE3_TPU_BLOCK_E': '512',
+                                    'SE3_TPU_BLOCK_IF': '16'}),
                 ('override_512_8', {'SE3_TPU_BLOCK_E': '512',
                                     'SE3_TPU_BLOCK_IF': '8'})]
         for arm, env in arms:
@@ -393,12 +380,24 @@ def main():
         clear_kernel_caches()
 
     def stage_kernel_tune():
-        import kernel_tune
-        kernel_tune.main(['--iters', '30',
-                          '--block-e', '0', '256', '512',
-                          '--block-if', '16', '32',
-                          '--block-cb', '8', '16'])
-        log('kernel_tune: completed (KERNEL_TUNE.jsonl)')
+        """END-TO-END autotune (scripts/tune_kernels.py — supersedes the
+        retired standalone kernel_tune.py sweep whose rankings were
+        measured opposite to end-to-end): candidates run through the
+        real bench step in alternating A/B pairs; winners land in the
+        persistent shape-keyed table (kernels/tuning.py) and the next
+        bench stages consult them (their records carry kernel_tuning).
+        In-process by construction, so it cannot deadlock against our
+        own single-client tunnel claim."""
+        import tune_kernels
+        rc = tune_kernels.main(
+            ['--out', os.path.join(os.path.dirname(here), 'TUNE.jsonl'),
+             '--steps', '10', '--pairs', '2', '--max-candidates', '4'])
+        log(f'tune_kernels: completed rc={rc} (TUNE.jsonl)')
+        if rc:
+            # the tuner's gate is its exit code (a promoted entry that
+            # failed the adoption-proof re-trace, or candidate errors);
+            # swallowing it would record a failed sweep as a green stage
+            raise RuntimeError(f'tune_kernels exited rc={rc}')
 
     def stage_tpu_checks():
         import tpu_checks
@@ -483,7 +482,8 @@ def main():
         ('block_ab',
          'conservative (512,16) vs (512,8) same-session block A/B',
          stage_block_ab, True),
-        ('tune', 'kernel block-size tuning sweep', stage_kernel_tune, True),
+        ('tune', 'end-to-end kernel autotune (shape-keyed table)',
+         stage_kernel_tune, True),
         ('checks', 'tpu_checks', stage_tpu_checks, True),
         ('timings', 'stage timings (flagship bench config)',
          stage_stage_timings, True),

@@ -86,6 +86,7 @@ class InferenceEngine:
         self.timer = timer if timer is not None else PhaseTimer()
         self._executables: Dict[Tuple[int, int, str], Callable] = {}
         self.compile_seconds: Dict[Tuple[int, int, str], float] = {}
+        self.tuning_consults: list = []  # filled by warmup()
         self.batches_served: Dict[int, int] = {b: 0 for b in self.buckets}
         self.rows_served: Dict[int, int] = {b: 0 for b in self.buckets}
         if precompile:
@@ -177,9 +178,39 @@ class InferenceEngine:
     def warmup(self) -> Dict[Tuple[int, int, str], float]:
         """Compile every bucket; returns per-executable compile seconds.
         Call before arming a RetraceWatchdog — afterwards a healthy
-        engine produces ZERO compile events."""
+        engine produces ZERO compile events.
+
+        Also records which kernel block picks the AOT compiles resolved
+        from the measured tuning table vs the heuristic
+        (kernels/tuning.py): `tuning_consults` / stats()['kernel_tuning']
+        — a serving deployment benchmarked under a tuned entry must be
+        distinguishable from a heuristic one in its telemetry."""
+        from ..kernels import tuning
+        # drop the kernel jit caches first: picks resolve at trace time,
+        # so a kernel traced earlier in-process (training, a prior
+        # engine) would compile these buckets without recording a single
+        # consult (the masquerading failure bench.py also guards)
+        if any(self._key(b) not in self._executables
+               for b in self.buckets):
+            tuning.clear_kernel_caches()
+        snap = tuning.snapshot()
         for b in self.buckets:
             self.compile_bucket(b)
+        consults = tuning.consults_since(snap)
+        if consults or not self.tuning_consults:
+            # a re-warmup with every bucket already compiled records an
+            # (accurate) empty delta — it must not wipe the consults of
+            # the warmup that actually built the executables
+            self.tuning_consults = consults
+        adopted = [c for c in self.tuning_consults
+                   if c['source'] != 'heuristic']
+        if adopted:
+            import sys
+            print('engine warmup: tuned kernel table entries in effect: '
+                  + '; '.join(
+                      f"{c['kernel']}{tuple(c['shape'])}->"
+                      f"{tuple(c['blocks'])} ({c['source']})"
+                      for c in adopted), file=sys.stderr)
         return dict(self.compile_seconds)
 
     # ------------------------------------------------------------------ #
@@ -230,4 +261,5 @@ class InferenceEngine:
             batches_served={str(b): n
                             for b, n in self.batches_served.items() if n},
             rows_served={str(b): n
-                         for b, n in self.rows_served.items() if n})
+                         for b, n in self.rows_served.items() if n},
+            kernel_tuning=list(self.tuning_consults))

@@ -120,13 +120,39 @@ def _block_row_bytes(J: int, D: int, bwd: bool) -> int:
 
 
 def _pick_block_n(n: int, J: int, D: int, bwd: bool = False) -> int:
+    """block_n resolution (forward): the measured shape-keyed table
+    (kernels.tuning, kind 'attention' — the tuner admits candidates
+    against the BACKWARD row model, since training differentiates with
+    the same block family) first, then the VMEM-ladder heuristic. The
+    backward always runs the heuristic against its own ~2x row model;
+    with an empty table every pick is bit-identical to the heuristic."""
     row = _block_row_bytes(J, D, bwd)
-    for block_n in (512, 256, 128, 64, 32, 16, 8):
-        if block_n * row <= _VMEM_LIMIT:
-            # never exceed n rounded up to the 8-row sublane minimum
-            # (a tiny input must not pad to a full 512-row block)
-            return min(block_n, max(8, _round_up(n, 8)))
-    return 8
+    cap = max(8, _round_up(n, 8))  # a tiny input must not pad to a full
+    # 512-row block
+
+    def _heuristic():
+        for block_n in (512, 256, 128, 64, 32, 16, 8):
+            if block_n * row <= _VMEM_LIMIT:
+                return min(block_n, cap)
+        return 8
+
+    if bwd:
+        return _heuristic()
+    from . import tuning
+    hit = tuning.lookup('attention', (n, J, D))
+    if hit is not None:
+        blocks, source = hit
+        if len(blocks) == 1 and (
+                source == 'forced'
+                or tuning.validate_entry('attention', (n, J, D), blocks)):
+            block_n = min(int(blocks[0]), cap)
+            tuning.record_consult('attention', (n, J, D), 'float32',
+                                  source, (block_n,))
+            return block_n
+    block_n = _heuristic()
+    tuning.record_consult('attention', (n, J, D), 'float32', 'heuristic',
+                          (block_n,))
+    return block_n
 
 
 def fused_attention_fits(J: int, D: int, bwd: bool = True) -> bool:
@@ -422,10 +448,11 @@ def _att_partitioned(heads, scale, interpret, has_mask, bwd):
         rule = f'a n d, b n j d, b n j d{mask_term} -> a n d'
     # special-factor indices must be sorted by first appearance in the
     # rule: d (q's last dim) precedes the slot axis j
-    f.def_partition(partition=partition,
-                    infer_sharding_from_operands=infer,
-                    sharding_rule=rule,
-                    need_replication_factors=('d', 'j'))
+    from .pallas_pairwise import _def_partition_compat
+    _def_partition_compat(f, partition=partition,
+                          infer_sharding_from_operands=infer,
+                          sharding_rule=rule,
+                          need_replication_factors=('d', 'j'))
     return f
 
 

@@ -60,13 +60,14 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _block_overrides(*names):
-    """Forward block-size env overrides for tuning sweeps
-    (scripts/kernel_tune.py): SE3_TPU_BLOCK_E paired with
-    SE3_TPU_BLOCK_IF (plain) / SE3_TPU_BLOCK_CB (bx). BOTH variables of
-    a pair must be set — a lone one warns and is ignored. Read per call;
-    the sweep runs one subprocess per setting because the jit cache keys
-    on shapes/statics, not env. Backward kernels never use overrides
-    (their working set is ~2x the forward's)."""
+    """Forward block-size env overrides — the highest-priority escape
+    hatch, above the measured table (kernels.tuning) and the heuristic:
+    SE3_TPU_BLOCK_E paired with SE3_TPU_BLOCK_IF (plain) /
+    SE3_TPU_BLOCK_CB (bx). BOTH variables of a pair must be set — a
+    lone one warns and is ignored. Read per call (the jit cache keys on
+    shapes/statics, not env — clear the entry-point caches after
+    flipping them, see tuning.clear_kernel_caches). Backward kernels
+    never use overrides (their working set is ~2x the forward's)."""
     import os
     vals = [os.environ.get(n, '') for n in names]
     if all(vals):
@@ -114,12 +115,68 @@ def _validate_override(block_e, second, second_name, full_second,
     return True
 
 
+def _vmem_plain(be: int, bif: int, IF: int, O: int, P: int, mid: int,
+                bwd: bool = False) -> int:
+    """Working-set bytes of the plain kernel at (be, bif) — the model
+    _pick_blocks budgets against and tuning.admissible_candidates
+    admits with. bif*O*128: the [S, 1] bias column tile-pads its lane
+    dim to 128."""
+    total = 4 * (mid * be + bif * O * mid + bif * O * 128
+                 + 2 * bif * O * be + P * bif * be + P * O * be)
+    if bwd:
+        # kernel A additionally holds h_p (be*mid), the gT block
+        # (= out-sized), the dv2 block (= v2-sized), the dw3 block
+        # (= w3-sized) and the db3 block (= b3-sized)
+        total += 4 * (be * mid + P * O * be + P * bif * be
+                      + bif * O * mid + bif * O * 128)
+    return total
+
+
+def _vmem_bx(be: int, cb: int, O: int, P: int, Q: int, F: int,
+             mid: int) -> int:
+    """Working-set bytes of the basis-fused kernel at (be, cb)."""
+    return 4 * (mid * be + cb * F * O * mid + cb * F * O * 128
+                + 2 * cb * F * O * be
+                + P * F * Q * be + cb * Q * be + P * O * be)
+
+
+def _consult_table(kind, shape, dtype, heuristic_fn):
+    """Measured-config table consult (kernels.tuning), between the env
+    override and the heuristic: forced tuner candidates and promoted
+    cache entries steer the pick; a cache entry failing the tile-quantum
+    / VMEM admission model degrades to the heuristic with a warning.
+    Every resolution is recorded for telemetry (bench record / serving
+    warmup / run report)."""
+    from . import tuning
+    hit = tuning.lookup(kind, shape, dtype=dtype)
+    if hit is not None:
+        blocks, source = hit
+        # forced candidates were admitted by the tuner's own enumeration;
+        # re-validating them here would just duplicate warnings
+        if source == 'forced' or tuning.validate_entry(kind, shape,
+                                                       blocks):
+            tuning.record_consult(kind, shape, dtype, source, blocks)
+            return blocks
+    blocks = heuristic_fn()
+    tuning.record_consult(kind, shape, dtype, 'heuristic', blocks)
+    return blocks
+
+
 def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
                  vmem_budget: Optional[int] = None,
-                 max_unroll: int = 256, bwd: bool = False):
+                 max_unroll: int = 256, bwd: bool = False,
+                 dtype: str = 'float32'):
     """Choose (block_e, block_if) so the working set fits in VMEM (with
     headroom for double buffering) and the in-kernel unrolled loop count
     P*block_if stays bounded (Mosaic compile time).
+
+    Resolution order (forward only — the backward always runs this
+    heuristic against its own 6 MiB model): SE3_TPU_BLOCK_E/IF env
+    overrides, then the measured shape-keyed table (kernels.tuning:
+    tuner-forced candidates, then promoted cache entries), then the
+    VMEM-model heuristic below. With no overrides and an empty table the
+    pick is bit-identical to the heuristic (regression-pinned in
+    tests/test_kernel_tuning.py).
 
     Budget: 7 MiB forward / 6 MiB backward. The forward bump is an
     END-TO-END measured adoption (the only kind this picker accepts —
@@ -160,42 +217,38 @@ def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
         vmem_budget = (6 if bwd else 7) * 2 ** 20  # see docstring
 
     def _vmem(be, bif):
-        # bif*O*128: the [S, 1] bias column tile-pads its lane dim to 128
-        return 4 * (mid * be + bif * O * mid + bif * O * 128
-                    + 2 * bif * O * be + P * bif * be + P * O * be)
+        return _vmem_plain(be, bif, IF, O, P, mid)
 
-    if not bwd:  # sweeps time the forward; the bwd working set is ~2x,
-        # so overrides never bypass the bwd VMEM model
-        ov = _block_overrides('SE3_TPU_BLOCK_E', 'SE3_TPU_BLOCK_IF')
-        if ov and _validate_override(ov[0], ov[1], 'SE3_TPU_BLOCK_IF', IF,
-                                     _vmem, vmem_budget):
-            return ov[0], min(IF, ov[1])
-    e_cap = _round_up(E, 128)
-    for block_e in (512, 256, 128):
-        if block_e > e_cap:
-            continue
-        block_if = min(IF, max(1, max_unroll // max(P, 1)))
-        if block_if < IF:
-            block_if = max(8, block_if // 8 * 8)
-        while True:
-            ht = mid * block_e
-            w3 = block_if * O * mid
-            rt = block_if * O * block_e
-            v2 = P * block_if * block_e
-            out = P * O * block_e
-            b3 = block_if * O * 128  # [S, 1] bias column, lanes pad to 128
-            total = 4 * (ht + w3 + b3 + 2 * rt + v2 + out)
-            if bwd:
-                # kernel A additionally holds h_p (block_e*mid), the gT
-                # block (= out-sized), the dv2 block (= v2-sized), the
-                # dw3 block (= w3-sized) and the db3 block (= b3-sized)
-                total += 4 * (block_e * mid + out + v2 + w3 + b3)
-            if total <= vmem_budget:
-                return block_e, block_if
-            if block_if <= 8:
-                break
-            block_if = max(8, block_if // 2 // 8 * 8)
-    return 128, min(IF, 8)
+    def _heuristic():
+        e_cap = _round_up(E, 128)
+        for block_e in (512, 256, 128):
+            if block_e > e_cap:
+                continue
+            block_if = min(IF, max(1, max_unroll // max(P, 1)))
+            if block_if < IF:
+                block_if = max(8, block_if // 8 * 8)
+            while True:
+                if _vmem_plain(block_e, block_if, IF, O, P, mid,
+                               bwd=bwd) <= vmem_budget:
+                    return block_e, block_if
+                if block_if <= 8:
+                    break
+                block_if = max(8, block_if // 2 // 8 * 8)
+        return 128, min(IF, 8)
+
+    if bwd:
+        # the backward never takes overrides or table entries (its ~2x
+        # working set was only ever validated under this model's picks)
+        return _heuristic()
+    ov = _block_overrides('SE3_TPU_BLOCK_E', 'SE3_TPU_BLOCK_IF')
+    if ov and _validate_override(ov[0], ov[1], 'SE3_TPU_BLOCK_IF', IF,
+                                 _vmem, vmem_budget):
+        from . import tuning
+        blocks = ov[0], min(IF, ov[1])
+        tuning.record_consult('plain', (E, IF, O, P, mid), dtype, 'env',
+                              blocks)
+        return blocks
+    return _consult_table('plain', (E, IF, O, P, mid), dtype, _heuristic)
 
 
 def _fwd_kernel(ht_ref, w3t_ref, b3t_ref, v2t_ref, o_ref, *, P, O, bif,
@@ -255,6 +308,10 @@ def _fused_pairwise_conv_impl(h, w3, b3, v2, interpret, precision):
     E, mid = h.shape
     _, IF, O = w3.shape
     P = v2.shape[1]
+    # table key dtype: the dominant-stream storage dtype (conv_bf16
+    # halves the V2 traffic, so its measured winner may differ from the
+    # f32 one) — captured BEFORE the interpret-mode upcasts below
+    key_dtype = jnp.dtype(v2.dtype).name
 
     # bf16 radial operands (radial_bf16): run the rt dot MXU-native with
     # f32 accumulation. Must be an EXPLICIT DEFAULT: None inherits the
@@ -271,7 +328,7 @@ def _fused_pairwise_conv_impl(h, w3, b3, v2, interpret, precision):
         # here is bit-identical — quantize-then-f32 either way
         v2 = v2.astype(jnp.float32)
 
-    block_e, block_if = _pick_blocks(E, IF, O, P, mid)
+    block_e, block_if = _pick_blocks(E, IF, O, P, mid, dtype=key_dtype)
     Ep, IFp = _round_up(E, block_e), _round_up(IF, block_if)
 
     ht, w3t, v2t, _ = _to_lanes(h, w3, v2)
@@ -400,11 +457,28 @@ def _make_partitioned(impl, rule, need_repl, arg_specs, result_specs,
         res = _shardings(m, result_specs(P_, e, o))
         return res[0] if single else res
 
-    f.def_partition(partition=partition,
-                    infer_sharding_from_operands=infer,
-                    sharding_rule=rule,
-                    need_replication_factors=need_repl)
+    _def_partition_compat(f, partition=partition,
+                          infer_sharding_from_operands=infer,
+                          sharding_rule=rule,
+                          need_replication_factors=need_repl)
     return f
+
+
+def _def_partition_compat(f, **kwargs):
+    """def_partition across jax generations: the Shardy-era kwargs
+    (sharding_rule / need_replication_factors) don't exist on GSPMD-era
+    jax (<= 0.4.x) — there the partition/infer callbacks alone carry the
+    semantics and the rule string is advisory, so dropping the two
+    kwargs loses nothing. Without this fallback EVERY kernel entry point
+    (including interpret mode on CPU) raises at trace time on older
+    installs."""
+    try:
+        f.def_partition(**kwargs)
+    except TypeError:
+        kwargs = {k: v for k, v in kwargs.items()
+                  if k not in ('sharding_rule',
+                               'need_replication_factors')}
+        f.def_partition(**kwargs)
 
 
 @functools.lru_cache(maxsize=None)
@@ -506,67 +580,71 @@ def _fwd_bx_kernel(ht_ref, w3t_ref, b3t_ref, bt_ref, xt_ref, o_ref, *,
 
 def _pick_blocks_bx(E: int, C: int, O: int, P: int, Q: int, F: int,
                     mid: int, vmem_budget: int = 6 * 2 ** 20,
-                    max_unroll: int = 512):
+                    max_unroll: int = 512, kind: str = 'bx',
+                    dtype: str = 'float32'):
     """(block_e, cb) for the basis-fused kernel. cb is the c-chunk: a
     multiple of 8 (so the xt row-block cb*Q and w3t row-block cb*F*O are
     tile-aligned for any odd Q/F) or the full (padded) C.
+
+    Resolution order mirrors _pick_blocks: SE3_TPU_BLOCK_E/CB env
+    overrides, then the measured shape-keyed table (kernels.tuning —
+    'bx' and 'bxf' are distinct kinds: same contraction, different HBM
+    basis operand), then the heuristic below.
 
     The round-4 KERNEL_TUNE standalone sweep at the flagship bxf shape
     measured the default (128, 8) within 2% of the best override
     (7.896 vs 7.723 ms at (512, 8)) — and the plain picker's cautionary
     tale applies (see _pick_blocks: a standalone-sweep-derived
     "improvement" cost the production conservative path 2.7x), so the
-    budget and ordering stay as production-validated; the
-    SE3_TPU_BLOCK_E/CB overrides are the experimentation path."""
+    budget and ordering stay as production-validated; the overrides and
+    the end-to-end tuner (scripts/tune_kernels.py) are the
+    experimentation paths."""
     def _vmem(be, cb):
-        return 4 * (mid * be + cb * F * O * mid + cb * F * O * 128
-                    + 2 * cb * F * O * be
-                    + P * F * Q * be + cb * Q * be + P * O * be)
+        return _vmem_bx(be, cb, O, P, Q, F, mid)
 
+    def _heuristic():
+        for block_e in (512, 256, 128):
+            if block_e > _round_up(E, 128):
+                continue
+            cb = min(_round_up(C, 8), max(8, max_unroll // max(P * F, 1)
+                                          // 8 * 8))
+            while True:
+                if _vmem_bx(block_e, cb, O, P, Q, F, mid) <= vmem_budget:
+                    return block_e, cb
+                if cb <= 8:
+                    break
+                cb = max(8, cb // 2 // 8 * 8)
+        # even the smallest block exceeds the model budget: the estimate
+        # mirrors the loop's accounting at (128, 8). The flagship bxf
+        # shape (P=7, Q=7, F=7, O=64, mid=128) lands here at ~7.5 MiB and
+        # is PRODUCTION-VALIDATED on the v5e (round-4 kernel_smoke +
+        # bench at record throughput) — the model is conservative, so
+        # estimates within a margin of that validated point stay SILENT
+        # (ADVICE r4 #3: a warning that fires on every healthy flagship
+        # run trains users to ignore it). Only genuinely larger shapes
+        # get the heads-up that pre-explains a real Mosaic VMEM failure.
+        total = _vmem(128, 8)
+        validated_silence = 9 * 2 ** 20  # flagship 7.5 MiB + margin
+        if total > validated_silence:
+            import warnings
+            warnings.warn(
+                f'fused bx kernel working-set model ~{total / 2**20:.1f} '
+                f'MiB exceeds the {vmem_budget / 2**20:.0f} MiB budget '
+                f'even at the smallest block (P={P}, Q={Q}, F={F}, O={O}, '
+                f'mid={mid}) and is beyond the production-validated '
+                f'~7.5 MiB flagship point; using (128, 8) — a Mosaic '
+                f'VMEM error here means: use the unfused path',
+                stacklevel=4)
+        return 128, 8
+
+    shape = (E, C, O, P, Q, F, mid)
     ov = _block_overrides('SE3_TPU_BLOCK_E', 'SE3_TPU_BLOCK_CB')
     if ov and _validate_override(ov[0], ov[1], 'SE3_TPU_BLOCK_CB',
                                  _round_up(C, 8), _vmem, vmem_budget):
+        from . import tuning
+        tuning.record_consult(kind, shape, dtype, 'env', ov)
         return ov
-    for block_e in (512, 256, 128):
-        if block_e > _round_up(E, 128):
-            continue
-        cb = min(_round_up(C, 8), max(8, max_unroll // max(P * F, 1)
-                                      // 8 * 8))
-        while True:
-            ht = mid * block_e
-            w3 = cb * F * O * mid
-            b3 = cb * F * O * 128  # [S, 1] bias column, lanes pad to 128
-            rt = cb * F * O * block_e
-            bt = P * F * Q * block_e
-            xt = cb * Q * block_e
-            out = P * O * block_e
-            total = 4 * (ht + w3 + b3 + 2 * rt + bt + xt + out)
-            if total <= vmem_budget:
-                return block_e, cb
-            if cb <= 8:
-                break
-            cb = max(8, cb // 2 // 8 * 8)
-    # even the smallest block exceeds the model budget: the estimate
-    # mirrors the loop's accounting at (128, 8). The flagship bxf shape
-    # (P=7, Q=7, F=7, O=64, mid=128) lands here at ~7.5 MiB and is
-    # PRODUCTION-VALIDATED on the v5e (round-4 kernel_smoke + bench at
-    # record throughput) — the model is conservative, so estimates
-    # within a margin of that validated point stay SILENT (ADVICE r4
-    # #3: a warning that fires on every healthy flagship run trains
-    # users to ignore it). Only genuinely larger shapes get the
-    # heads-up that pre-explains a real Mosaic VMEM failure.
-    total = _vmem(128, 8)
-    validated_silence = 9 * 2 ** 20  # flagship 7.5 MiB + margin
-    if total > validated_silence:
-        import warnings
-        warnings.warn(
-            f'fused bx kernel working-set model ~{total / 2**20:.1f} MiB '
-            f'exceeds the {vmem_budget / 2**20:.0f} MiB budget even at '
-            f'the smallest block (P={P}, Q={Q}, F={F}, O={O}, mid={mid}) '
-            f'and is beyond the production-validated ~7.5 MiB flagship '
-            f'point; using (128, 8) — a Mosaic VMEM error here means: '
-            f'use the unfused path', stacklevel=3)
-    return 128, 8
+    return _consult_table(kind, shape, dtype, _heuristic)
 
 
 def _fused_pairwise_conv_bx_impl(h, w3, b3, basis, x, interpret, precision,
@@ -585,6 +663,9 @@ def _fused_pairwise_conv_bx_impl(h, w3, b3, basis, x, interpret, precision,
     C = x.shape[1]
     O = w3.shape[-1]
     assert w3.shape[1] == C * F, (w3.shape, C, F)
+    # table key dtype: basis/x storage width (conv_bf16), captured
+    # before the interpret-mode upcasts below
+    key_dtype = jnp.dtype(basis.dtype).name
     if h.dtype == jnp.bfloat16:  # see fused_pairwise_conv (explicit
         # DEFAULT — None would inherit a possibly-fp32 context precision,
         # which Mosaic rejects on bf16 operands)
@@ -599,7 +680,9 @@ def _fused_pairwise_conv_bx_impl(h, w3, b3, basis, x, interpret, precision,
         if x.dtype == jnp.bfloat16:
             x = x.astype(jnp.float32)
 
-    block_e, cb = _pick_blocks_bx(E, C, O, P, Q, F, mid)
+    block_e, cb = _pick_blocks_bx(E, C, O, P, Q, F, mid,
+                                  kind='bxf' if pqf is not None else 'bx',
+                                  dtype=key_dtype)
     Cp = _round_up(C, cb)
     Ep = _round_up(E, block_e)
 

@@ -36,4 +36,5 @@ from .schema import (  # noqa: F401
 )
 from .report import (  # noqa: F401
     load_jsonl, summarize_bench_records, summarize_telemetry,
+    summarize_tune_records,
 )

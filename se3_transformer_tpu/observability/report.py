@@ -113,7 +113,8 @@ def summarize_telemetry(records: List[dict],
             continue
         if rid not in runs:
             runs[rid] = dict(meta=None, flushes=[], summary=None,
-                             retrace_warnings=0, steps=[], pipeline=None)
+                             retrace_warnings=0, steps=[], pipeline=None,
+                             tune=[])
             order.append(rid)
         kind = rec.get('kind')
         if kind == 'run_meta':
@@ -129,6 +130,8 @@ def summarize_telemetry(records: List[dict],
         elif kind == 'pipeline':
             # cumulative counters: the last record of the run wins
             runs[rid]['pipeline'] = rec
+        elif kind == 'tune':
+            runs[rid]['tune'].append(rec)
 
     out = []
     for rid in order:
@@ -180,8 +183,32 @@ def summarize_telemetry(records: List[dict],
             rec['pipeline'] = {k: pipe[k] for k in
                                ('steps', 'queue', 'prefetch', 'verdict')
                                if k in pipe}
+        if run['tune']:
+            rec['kernel_tuning'] = summarize_tune_records(run['tune'])
         out.append(rec)
     return out
+
+
+def summarize_tune_records(records: List[dict]) -> dict:
+    """Reduce a tune-record stream (scripts/tune_kernels.py) to the
+    adopted-vs-heuristic view the run report surfaces: per-verdict
+    counts plus the promoted entries with their end-to-end evidence."""
+    tunes = [r for r in records if r.get('kind', 'tune') == 'tune']
+    verdicts = {}
+    for r in tunes:
+        v = r.get('verdict', 'unknown')
+        verdicts[v] = verdicts.get(v, 0) + 1
+    promoted = [
+        {k: r[k] for k in ('kernel', 'shape', 'candidate', 'blocks',
+                           'step_ms', 'nodes_steps_per_sec', 'pairs',
+                           'incumbent') if k in r}
+        for r in tunes if r.get('promoted') and r.get('verdict') ==
+        'promoted']
+    consulted = [
+        {k: r[k] for k in ('kernel', 'shape', 'blocks') if k in r}
+        for r in tunes if r.get('verdict') == 'consulted']
+    return dict(candidates=len(tunes), verdicts=verdicts,
+                promoted=promoted, consulted=consulted)
 
 
 def summarize(records: List[dict], anchor: Optional[float] = None,

@@ -29,6 +29,15 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    REQUIRED here), queue_depth, runtime (watchdog
                    snapshot), post_warmup_compiles (REQUIRED — the AOT
                    zero-compile contract rides this field).
+  tune             one per kernel-autotuner candidate
+                   (scripts/tune_kernels.py): kernel kind + shape,
+                   candidate blocks, the end-to-end step_ms /
+                   nodes_steps_per_sec A/B evidence, and the
+                   load-bearing pair: verdict (admitted / promoted /
+                   rejected / consulted / error)
+                   + promoted (bool). Promotion evidence must be
+                   END-TO-END — the schema cannot check that, but the
+                   tuner records the pairs so a reviewer can.
   summary          end-of-run cumulative record (metrics, timing,
                    nodes_steps_per_sec, loss trajectory,
                    retrace_warnings_total).
@@ -45,7 +54,7 @@ from typing import Iterable, Union
 SCHEMA_VERSION = 1
 
 KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
-               'serve', 'summary')
+               'serve', 'tune', 'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
@@ -60,8 +69,16 @@ _REQUIRED = {
     # contract (must be 0) — a serve record without it is invalid
     'serve': ('run_id', 'requests', 'buckets', 'runtime', 'queue_depth',
               'post_warmup_compiles'),
+    # verdict + promoted are the load-bearing pair of the autotuner
+    # contract: a tune record that cannot say what happened to the
+    # candidate (and whether the table changed) proves nothing
+    'tune': ('run_id', 'kernel', 'shape', 'candidate', 'blocks', 'verdict',
+             'promoted'),
     'summary': ('run_id', 'steps', 'metrics', 'timing'),
 }
+
+_TUNE_VERDICTS = ('admitted', 'promoted', 'rejected', 'consulted',
+                  'error')
 
 _PIPELINE_PREFETCH_REQUIRED = ('depth', 'hits', 'stalls')
 _PIPELINE_VERDICTS = ('producer_bound', 'device_bound', 'balanced')
@@ -126,6 +143,21 @@ def validate_record(rec: dict, index=None) -> dict:
                 _fail(index, f'buckets[{bucket!r}] missing {missing} '
                              f'(per-bucket p50/p95/p99 are the SLO '
                              f'surface)')
+    if kind == 'tune':
+        if rec['verdict'] not in _TUNE_VERDICTS:
+            _fail(index, f'tune.verdict {rec["verdict"]!r} not in '
+                         f'{_TUNE_VERDICTS}')
+        if not isinstance(rec['promoted'], bool):
+            _fail(index, f'tune.promoted must be a bool, got '
+                         f'{rec["promoted"]!r}')
+        if rec['verdict'] == 'promoted' and not rec['promoted']:
+            _fail(index, 'tune verdict "promoted" requires promoted=true')
+        for field in ('candidate', 'blocks', 'shape'):
+            val = rec[field]
+            if not isinstance(val, (list, tuple)) or \
+                    not all(isinstance(v, int) for v in val):
+                _fail(index, f'tune.{field} must be a list of ints, '
+                             f'got {val!r}')
     if kind in ('flush', 'summary'):
         timing = rec['timing']
         if not isinstance(timing, dict):

@@ -258,7 +258,7 @@ def fetch_sync(tree) -> None:
 
 
 # Error classification for the axon remote-TPU runtime, shared by every
-# on-chip harness (bench, tpu_session, tpu_probe, kernel_tune). One list
+# on-chip harness (bench, tpu_session, tpu_probe, tune_kernels). One list
 # each: four hand-copied variants had already drifted apart (round-4
 # review), recreating the infinite relaunch-retry-OOM cycle they were
 # meant to kill. OOM is checked FIRST everywhere: the axon client wraps

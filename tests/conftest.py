@@ -12,6 +12,17 @@ if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
 os.environ['JAX_PLATFORMS'] = 'cpu'
+# Suite-wide cache isolation: basis.py (Q_J .npz, frozen at import) and
+# kernels/tuning.py (block-config table, read per call) both key off
+# SE3_TPU_CACHE_PATH. The default (~/.cache/se3_transformer_tpu) is
+# writable by `scripts/tune_kernels.py` runs, so without a redirect the
+# heuristic-pick pin tests (test_pallas, test_kernel_tuning) would read
+# whatever cpu-keyed entries a developer's sweep promoted — per-machine
+# mutable state in `make test`. A STABLE tests subdir (not a tmp dir)
+# keeps the Q_J cache warm across runs; set BEFORE any package import,
+# since basis.CACHE_PATH freezes at import time.
+os.environ['SE3_TPU_CACHE_PATH'] = os.path.expanduser(
+    '~/.cache/se3_transformer_tpu/tests')
 
 import jax  # noqa: E402
 
@@ -103,13 +114,76 @@ _HEAVY_TESTS = {
 }
 
 
+# `slow` re-tier (PR 4): jax 0.4.x in this environment lacks the Shardy
+# def_partition kwargs; until the `_def_partition_compat` fallback
+# landed, EVERY pallas-path test failed fast at trace time — and the
+# tier-1 gate's wall budget was sized around those instant failures.
+# With the kernels runnable again, the interpreter-mode MODEL-level
+# programs cost minutes each on this 1-core host (the file-level
+# measurements behind this list: test_pallas 37 tests = 445 s, the
+# ring suite + the 6 pjit+pallas sharding tests exceed 30 min combined,
+# with test_pallas_kernels_partition_under_pjit alone >20 min under the
+# simulated 8-device mesh). Those move to the `slow` tier (run by
+# `make test`, excluded from the timed gate) — every entry here was a
+# guaranteed FAILURE at the seed, so the gate loses no passing
+# coverage. The fast kernel-LEVEL numerics tests (~45 s total:
+# fwd/bwd/bx/attention oracles, picker pins, conv_bf16 oracle) and
+# tests/test_kernel_tuning.py stay tier-1.
+_SLOW_TESTS = {
+    # test_pallas: model-level interpret programs
+    'test_pairwise_conv_pallas_path_matches_xla',
+    'test_edge_chunks_composes_with_pallas',
+    'test_pallas_path_gradients',
+    'test_fused_kernels_multichunk_if_axis',
+    'test_fused_kernels_shape_fuzz',
+    'test_model_with_fused_attention_matches_einsum_path',
+    'test_fused_attention_big_j_falls_back',
+    'test_shared_radial_group_path',
+    'test_pairwise_conv_fuse_basis_matches_xla',
+    'test_convse3_fuse_basis_group_path',
+    'test_bxf_kernel_matches_bx',
+    'test_model_flat_basis_matches_structured',
+    'test_model_fuse_basis_matches_base',
+    'test_fuse_basis_composes_with_edge_chunks_and_bf16',
+    'test_conv_bf16_model_paths_agree_and_train',
+    'test_conv_bf16_equivariance_cost_bounded',
+    # test_ring: every test drives the ring collective model path
+    'test_ring_knn_exact',
+    'test_ring_knn_radius_semantics',
+    'test_ring_knn_feeds_model',
+    'test_ring_knn_respects_mask',
+    'test_sequence_parallel_ring_model_matches_dense',
+    'test_sequence_parallel_ring_long_context',
+    'test_ring_sparse_adjacency_matches_dense',
+    'test_ring_causal_matches_dense',
+    'test_ring_neighbor_mask_matches_dense',
+    'test_ring_adj_degrees_and_edges_match_dense',
+    'test_ring_sparse_bonded_beyond_radius_stay_valid',
+    'test_ring_sparse_jitter_parity_over_cap',
+    # test_sharding: the pjit+pallas / multi-device-model subset
+    'test_graft_entry_dryrun',
+    'test_tensor_parallel_params_partitioned_and_match_replicated',
+    'test_combined_ring_tp_dp_train_step',
+    'test_pallas_kernels_partition_under_pjit',
+    'test_fused_attention_partitions_under_pjit',
+    'test_checkpoint_roundtrip_preserves_shardings',
+    # test_radial_bf16: full fast-path model programs
+    'test_differentiable_coors_with_full_fast_path',
+    'test_radial_bf16_pallas_paths_match_xla',
+}
+
+
 def pytest_collection_modifyitems(config, items):
     matched = set()
+    slow_matched = set()
     for item in items:
         base = item.name.split('[')[0]
         if base in _HEAVY_TESTS:
             item.add_marker(pytest.mark.heavy)
             matched.add(base)
+        if base in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
+            slow_matched.add(base)
     # a renamed/deleted heavy test must not silently re-enter the fast
     # tier as a dead string here: error on unmatched entries whenever the
     # collection was broad enough to have seen every test (no -k filter,
@@ -122,6 +196,11 @@ def pytest_collection_modifyitems(config, items):
         raise pytest.UsageError(
             f'_HEAVY_TESTS entries matched no collected test (renamed or '
             f'deleted?): {sorted(stale)}')
+    stale_slow = _SLOW_TESTS - slow_matched
+    if stale_slow and broad:
+        raise pytest.UsageError(
+            f'_SLOW_TESTS entries matched no collected test (renamed or '
+            f'deleted?): {sorted(stale_slow)}')
 
 
 @pytest.fixture

@@ -15,24 +15,34 @@ import pytest
 
 
 @pytest.fixture(scope='module')
-def toy_record():
-    sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+def toy_record(request):
+    # module-scoped MonkeyPatch (the function-scoped fixture can't serve
+    # a module fixture): syspath and env edits are undone at teardown
+    # instead of leaking into the rest of the pytest process
+    mp = pytest.MonkeyPatch()
+    request.addfinalizer(mp.undo)
+    mp.syspath_prepend(os.path.dirname(os.path.dirname(__file__)))
+    # pin the eq knob: an ambient SE3_TPU_BENCH_EQ=0 (probe-style runs)
+    # would null equivariance_l2 and fail test_record_schema for an
+    # environmental reason
+    mp.delenv('SE3_TPU_BENCH_EQ', raising=False)
     import bench
 
     buf = io.StringIO()
     real_stdout = sys.stdout
-    # pin the eq knob: an ambient SE3_TPU_BENCH_EQ=0 (probe-style runs)
-    # would null equivariance_l2 and fail test_record_schema for an
-    # environmental reason
-    prior_eq = os.environ.pop('SE3_TPU_BENCH_EQ', None)
     sys.stdout = buf
     try:
         bench.main('cpu', fallback_reason='test_exercise')
     finally:
         sys.stdout = real_stdout
-        if prior_eq is not None:
-            os.environ['SE3_TPU_BENCH_EQ'] = prior_eq
-    return json.loads(buf.getvalue().strip().splitlines()[-1])
+    # the driver consumes bench's stdout as ONE JSON line; anything else
+    # (a stray print, a second record) is schema drift and must fail
+    # loudly here, not be silently skipped by a last-line parse
+    lines = [l for l in buf.getvalue().splitlines() if l.strip()]
+    assert len(lines) == 1, (
+        f'bench.py stdout must be exactly one JSON line, got '
+        f'{len(lines)}: {lines!r}')
+    return json.loads(lines[0])
 
 
 def test_toy_keeps_frozen_single_window(toy_record):
@@ -42,6 +52,8 @@ def test_toy_keeps_frozen_single_window(toy_record):
     assert toy_record['window_rates'] == [
         pytest.approx(toy_record['value'], abs=0.01)]
     assert toy_record['steps_trained'] == 10
+    # the estimator is named, never inferred from len(window_rates)
+    assert toy_record['timing'] == 'frozen-toy'
 
 
 def test_record_schema(toy_record):
@@ -55,9 +67,18 @@ def test_record_schema(toy_record):
     assert r['loss_first'] > r['loss_last']
     assert r['loss_decreased'] is True
     # CPU records carry equivariance (cheap off-chip); the twin scope
-    # label is chip-only
+    # label is chip-only. Check presence FIRST: bench.py records None
+    # and continues when the eq check raises, and None < 1e-4 would die
+    # as an unreadable TypeError (ADVICE r5 #2)
+    assert r['equivariance_l2'] is not None, (
+        'equivariance check was skipped or failed inside bench.main — '
+        'see the "equivariance check failed" line on the captured stderr')
     assert r['equivariance_l2'] < 1e-4
     assert r['fallback_reason'] == 'test_exercise'
+    # adopted-vs-heuristic kernel picks travel with every record (empty
+    # by_source on this CPU toy: the Pallas path is TPU/interpret-only)
+    assert 'kernel_tuning' in r
+    assert r['kernel_tuning']['adopted'] == []
 
 
 def test_rate_consistent_with_step_ms(toy_record):

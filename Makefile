@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke pipeline-smoke tune-smoke clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke pipeline-smoke tune-smoke ring-smoke clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -43,6 +43,11 @@ tune-smoke:        ## interpret-mode kernel-autotuner mini-sweep on CPU (docs/PE
 	SE3_TPU_CACHE_PATH=/tmp/tune_smoke_cache python scripts/tune_kernels.py --smoke --dry-run --max-targets 2 --out /tmp/tune_smoke.jsonl
 	SE3_TPU_CACHE_PATH=/tmp/tune_smoke_cache python scripts/tune_kernels.py --smoke --max-targets 1 --max-candidates 1 --pairs 1 --steps 2 --margin -1 --out /tmp/tune_smoke.jsonl
 	python scripts/obs_report.py /tmp/tune_smoke.jsonl --validate --require-tune --out /tmp/tune_smoke_summary.json
+
+ring-smoke:        ## virtual-8-device sequence-parallel comm gate (docs/PERFORMANCE.md "Sequence-parallel comms"): exchange-vs-dense parity + schema'd comm records + no full-width all-gather in the traced sp>1 exchange program
+	rm -f /tmp/ring_smoke.jsonl
+	python scripts/ring_smoke.py --metrics /tmp/ring_smoke.jsonl
+	python scripts/obs_report.py /tmp/ring_smoke.jsonl --validate --require-comm --out /tmp/ring_smoke_summary.json
 
 tpu-checks:        ## on-chip equivariance + kernel numerics/speed gate
 	python scripts/tpu_checks.py

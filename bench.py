@@ -684,7 +684,73 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None,
     return record
 
 
+def ring_main(n_devices: int, per_device_nodes: int = None):
+    """`python bench.py --ring N`: sequence-parallel comm A/B on an
+    N-virtual-device CPU mesh (sp=N ring-path training step, fixed
+    per-device nodes — the scripts/width_table.py --weak-scaling harness,
+    shared so the numbers are the same program PERF.md tables).
+
+    Prints ONE bench-shaped JSON line whose value is the
+    overlapped+sparse arm's nodes·steps/s; the serialized+dense control
+    arm rides along (`overlapped_vs_serialized`) with BOTH arms' schema'd
+    `comm` payloads — collective classes/bytes and the full-width
+    all-gather scan of each traced HLO (parallel.exchange.comm_payload),
+    the same end-to-end A/B discipline as --pipelined (never compared
+    against the single-device RECORD anchors: different program).
+
+    CPU-mesh caveat travels with the record: all virtual devices share
+    this host's cores, so overlap cannot hide transfer latency here —
+    the honest CPU-side win is the all-gather-free trace + flat
+    per-shard memory; overlap is measured for regression, not for the
+    ICI story (that needs a real pod)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'scripts'))
+    import width_table
+
+    if per_device_nodes is None:
+        per_device_nodes = int(os.environ.get('SE3_TPU_RING_PDN', 64))
+    jax = width_table._setup(n_devices)
+    arms = {}
+    for overlap, exchange, arm in ((True, True, 'overlapped_sparse'),
+                                   (False, False, 'serialized_dense')):
+        arms[arm] = width_table.weak_scaling_point(
+            jax, n_devices, per_device_nodes, dim=16, k=8,
+            overlap=overlap, exchange=exchange)
+    fast_arm = arms['overlapped_sparse']
+    n = fast_arm['n']
+    record = {
+        'metric': f'ring_comm_ab_nodes_steps_per_sec'
+                  f'(sp={n_devices},pdn={per_device_nodes},dim=16)',
+        'value': round(n / fast_arm['step_s'], 2),
+        'unit': 'nodes*steps/sec/cpu-host',
+        'vs_baseline': 1.0,  # own-program A/B; RECORD anchors don't apply
+        'mode': 'ring_ab',
+        'sp': n_devices,
+        'n': n,
+        'step_s': fast_arm['step_s'],
+        'serialized_dense_step_s': arms['serialized_dense']['step_s'],
+        'overlapped_vs_serialized': round(
+            arms['serialized_dense']['step_s'] / fast_arm['step_s'], 3),
+        'per_shard_total_gb': fast_arm.get('per_shard_total_gb'),
+        'comm': {arm: rec.get('comm') for arm, rec in arms.items()},
+        'loss_finite': bool(fast_arm.get('loss_finite')
+                            and arms['serialized_dense'].get('loss_finite')),
+    }
+    if os.environ.get('SE3_TPU_CODE_REV'):
+        record['code_rev'] = os.environ['SE3_TPU_CODE_REV']
+    print(json.dumps(record))
+    return record
+
+
 if __name__ == '__main__':
+    if '--ring' in sys.argv[1:]:
+        # CPU-mesh harness: no device probe (the TPU tunnel is a single
+        # chip — the sp story needs virtual devices), flags parsed before
+        # jax initializes its backends
+        _i = sys.argv.index('--ring')
+        _n = int(sys.argv[_i + 1]) if len(sys.argv) > _i + 1 else 8
+        ring_main(_n)
+        sys.exit(0)
     _pipelined = '--pipelined' in sys.argv[1:]
     _backend, _reason = _device_backend_or_cpu()
     main(_backend, fallback_reason=_reason, pipelined=_pipelined)

@@ -55,6 +55,12 @@ def main(argv=None):
                          'least one `tune` record, at least one '
                          'promotion, and a `consulted` verdict proving '
                          'the promoted entry steered the next pick')
+    ap.add_argument('--require-comm', action='store_true',
+                    help='gate a sequence-parallel run (make ring-smoke): '
+                         'exit non-zero unless the stream carries at '
+                         'least one `comm` record with exchange=true, '
+                         'and every such record proves the traced '
+                         'program free of full-width all-gathers')
     ap.add_argument('--require-pipeline', action='store_true',
                     help='gate a pipelined run: exit non-zero unless the '
                          'stream carries at least one `pipeline` record '
@@ -97,6 +103,30 @@ def main(argv=None):
             return 1
         print(f'pipeline gate ok: {hits} hits / {stalls} stalls, '
               f'verdict {pipes[-1].get("verdict")}', file=sys.stderr)
+
+    if args.require_comm:
+        comms = [r for r in records if r.get('kind') == 'comm']
+        if not comms:
+            print('COMM GATE: no comm records in the stream (was the run '
+                  'traced with the exchange instrumented?)',
+                  file=sys.stderr)
+            return 1
+        ex_arms = [r for r in comms if r.get('exchange')]
+        if not ex_arms:
+            print('COMM GATE: no exchange-enabled comm record — the '
+                  'sparse path was never traced', file=sys.stderr)
+            return 1
+        dirty = [r for r in ex_arms if not r.get('all_gather_free')]
+        if dirty:
+            shapes = [s for r in dirty
+                      for s in r.get('full_width_all_gathers', [])]
+            print(f'COMM GATE: {len(dirty)} exchange-enabled program(s) '
+                  f'still carry full-width all-gathers: {shapes}',
+                  file=sys.stderr)
+            return 1
+        print(f'comm gate ok: {len(comms)} comm records, '
+              f'{len(ex_arms)} exchange arms, all all-gather-free',
+              file=sys.stderr)
 
     if args.require_tune:
         tunes = [r for r in records if r.get('kind') == 'tune']

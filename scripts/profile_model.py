@@ -9,6 +9,8 @@ observability.timing.MODEL_SCOPES):
     attention / attn_qkv / attn_core                 attention block
     pallas_attention[_bwd]                           fused attention kernel
     ring_knn                                         sequence-parallel kNN
+    ici_wait / exchange                              ring ppermute hop /
+                                                     neighbor-sparse gather
 """
 import argparse
 import os

@@ -21,7 +21,12 @@ Usage (fresh process per device count — the virtual device count is
 fixed at backend init):
     python scripts/width_table.py --devices 8 --dims 512 [--exec-dim 512]
     python scripts/width_table.py --devices 1 --dims 64 128
-Writes crash-safe JSONL to WIDTH_TABLE.jsonl (append).
+    python scripts/width_table.py --devices 8 --weak-scaling --ab \
+        [--metrics COMM.jsonl]
+Writes crash-safe JSONL to WIDTH_TABLE.jsonl (append). --weak-scaling
+rows carry a `comm` payload (collective classes/bytes + the full-width
+all-gather scan of the traced HLO); --ab measures the overlapped+sparse
+vs serialized+dense comm arms in one process (docs/PERF.md's table).
 """
 import argparse
 import json
@@ -141,7 +146,8 @@ def measure_point(jax, mesh, dim, n, k, tp, execute=False):
     return rec
 
 
-def weak_scaling_point(jax, n_devices, per_device_nodes, dim, k, steps=3):
+def weak_scaling_point(jax, n_devices, per_device_nodes, dim, k, steps=3,
+                       overlap=True, exchange=True):
     """One weak-scaling row (VERDICT r4 next #8): sp=n_devices ring-path
     training step at FIXED per-device node count, executed for wall-clock
     + XLA per-shard memory. All virtual devices share this host's cores,
@@ -149,7 +155,13 @@ def weak_scaling_point(jax, n_devices, per_device_nodes, dim, k, steps=3):
     flat); the rows record step_s only — the overhead factor
     step_s / (sp * step_s_at_sp1) is derived downstream from the sp=1
     row (docs/PERF.md does this), and per-shard memory should stay
-    ~flat (the actual weak-scaling claim)."""
+    ~flat (the actual weak-scaling claim).
+
+    overlap/exchange are the PR-5 comm knobs (parallel/ring.py,
+    parallel/exchange.py); `--ab` measures both settings of the pair in
+    one process so the A/B shares the host. Every row carries a `comm`
+    payload — collective classes + bytes and the full-width-all-gather
+    scan of THIS row's traced HLO (parallel.exchange.comm_payload)."""
     import time as _time
 
     import jax.numpy as jnp
@@ -157,6 +169,7 @@ def weak_scaling_point(jax, n_devices, per_device_nodes, dim, k, steps=3):
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from se3_transformer_tpu.parallel.exchange import comm_payload
     from se3_transformer_tpu.parallel.mesh import make_mesh
     from se3_transformer_tpu.parallel.sharding import make_sharded_train_step
     from se3_transformer_tpu.training import recipes
@@ -165,7 +178,8 @@ def weak_scaling_point(jax, n_devices, per_device_nodes, dim, k, steps=3):
     mesh = make_mesh(jax.devices()[:n_devices], dp=1, tp=1)
     module = recipes.RECIPES['flagship_fast'](
         dim=dim, num_neighbors=k, output_degrees=2, reduce_dim_out=True,
-        depth=1, sequence_parallel='ring', mesh=mesh)
+        depth=1, sequence_parallel='ring', mesh=mesh,
+        ring_overlap=overlap, ring_exchange=exchange)
 
     rng = np.random.RandomState(0)
     node_spec = P(None, 'sp', None)
@@ -201,7 +215,14 @@ def weak_scaling_point(jax, n_devices, per_device_nodes, dim, k, steps=3):
     rec = dict(weak_scaling=True, devices=n_devices, sp=n_devices,
                per_device_nodes=per_device_nodes, n=n, dim=dim, k=k,
                depth=1, compile_s=round(compile_s, 1),
-               host_cpus=os.cpu_count(), backend='cpu-spmd')
+               host_cpus=os.cpu_count(), backend='cpu-spmd',
+               overlap=overlap, exchange=exchange)
+    try:
+        rec['comm'] = comm_payload(
+            compiled.as_text(), sp=n_devices, ring_steps=n_devices,
+            overlap=overlap, exchange=exchange, full_width_dim=n)
+    except Exception as e:  # noqa: BLE001 - accounting is best-effort
+        rec['comm_error'] = f'{type(e).__name__}: {e}'[:200]
     try:
         ma = compiled.memory_analysis()
         if isinstance(ma, (list, tuple)):
@@ -222,6 +243,18 @@ def weak_scaling_point(jax, n_devices, per_device_nodes, dim, k, steps=3):
     rec['step_s'] = round((_time.time() - t0) / steps, 3)
     rec['loss_finite'] = bool(jax.numpy.isfinite(out[2]))
     return rec
+
+
+def _write_comm_stream(path, recs):
+    """Schema-valid telemetry stream for the weak-scaling run: run_meta +
+    one `comm` record per measured arm (observability.schema kind='comm'
+    — `make ring-smoke` gates it via obs_report --require-comm)."""
+    from se3_transformer_tpu.observability.report import write_comm_stream
+
+    write_comm_stream(
+        path, f'weak_scaling_{os.getpid()}',
+        [dict(rec['comm'], step_s=rec.get('step_s'), label=rec.get('arm'))
+         for rec in recs if 'comm' in rec])
 
 
 def main(argv=None):
@@ -249,16 +282,57 @@ def main(argv=None):
                          'per device count)')
     ap.add_argument('--per-device-nodes', type=int, default=256)
     ap.add_argument('--weak-dim', type=int, default=16)
+    ap.add_argument('--ab', action='store_true',
+                    help='with --weak-scaling: measure BOTH comm arms in '
+                         'this process — overlapped+sparse (the default '
+                         'path) and serialized+dense (ring_overlap='
+                         'ring_exchange=False, the pre-PR5 program) — so '
+                         'the A/B shares the host and the overhead delta '
+                         'is attributable to the comm discipline alone')
+    ap.add_argument('--no-overlap', action='store_true',
+                    help='with --weak-scaling (single-arm): serialize the '
+                         'ring ppermutes')
+    ap.add_argument('--no-exchange', action='store_true',
+                    help='with --weak-scaling (single-arm): dense global '
+                         'gathers instead of the neighbor-sparse exchange')
+    ap.add_argument('--metrics', default=None,
+                    help='with --weak-scaling: also write a schema-valid '
+                         'telemetry stream (run_meta + one comm record '
+                         'per arm) for scripts/obs_report.py '
+                         '--require-comm')
     args = ap.parse_args(argv)
 
     jax = _setup(args.devices)
 
     if args.weak_scaling:
-        rec = weak_scaling_point(jax, args.devices, args.per_device_nodes,
-                                 args.weak_dim, min(args.k, 8))
-        print(json.dumps(rec), flush=True)
-        with open(args.out, 'a') as f:
-            f.write(json.dumps(rec) + '\n')
+        arms = [(True, True), (False, False)] if args.ab else \
+            [(not args.no_overlap, not args.no_exchange)]
+        recs = []
+        for overlap, exchange in arms:
+            rec = weak_scaling_point(
+                jax, args.devices, args.per_device_nodes, args.weak_dim,
+                min(args.k, 8), overlap=overlap, exchange=exchange)
+            rec['arm'] = ('overlapped_sparse' if overlap and exchange
+                          else 'serialized_dense'
+                          if not (overlap or exchange) else
+                          f'overlap={overlap},exchange={exchange}')
+            recs.append(rec)
+            print(json.dumps(rec), flush=True)
+            with open(args.out, 'a') as f:
+                f.write(json.dumps(rec) + '\n')
+        if len(recs) == 2 and all('step_s' in r for r in recs):
+            ratio = dict(weak_scaling_ab=True, devices=args.devices,
+                         sp=args.devices, n=recs[0]['n'],
+                         dim=args.weak_dim,
+                         overlapped_sparse_step_s=recs[0]['step_s'],
+                         serialized_dense_step_s=recs[1]['step_s'],
+                         overlapped_vs_serialized=round(
+                             recs[1]['step_s'] / recs[0]['step_s'], 3))
+            print(json.dumps(ratio), flush=True)
+            with open(args.out, 'a') as f:
+                f.write(json.dumps(ratio) + '\n')
+        if args.metrics:
+            _write_comm_stream(args.metrics, recs)
         return
     from se3_transformer_tpu.parallel.mesh import make_mesh
     devices = jax.devices()[:args.devices]

@@ -21,6 +21,7 @@ is what you jit / pjit / shard.
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict, Optional, Tuple, Union
 
 import flax.linen as nn
@@ -145,6 +146,35 @@ class SE3TransformerModule(nn.Module):
     # exist on any device. Requires `mesh`; plain-kNN semantics only.
     sequence_parallel: Optional[str] = None
     mesh: Optional[jax.sharding.Mesh] = None
+    # ring comm knobs (parallel/ring.py, parallel/exchange.py). Both are
+    # bit-exact off-switches kept for A/B measurement:
+    #   ring_overlap   double-buffer the ring's ppermutes so ICI hides
+    #                  under the score/select compute (identical results
+    #                  either way — parallel.ring.ring_scan);
+    #   ring_exchange  neighbor-sparse feature exchange: gather coors/
+    #                  mask/edges/adjacency AND the trunk's neighbor
+    #                  features by rotating owned value blocks instead of
+    #                  a GSPMD global gather (which all-gathers the full
+    #                  [b, N, ...] operand onto every device). Off = the
+    #                  dense batched_index_select path, exact parity.
+    ring_overlap: bool = True
+    ring_exchange: bool = True
+
+    def __post_init__(self):
+        # fiber dicts arrive as {degree: channels} with INT keys — the
+        # reference's constructor surface. flax registers submodule
+        # attributes through serialization.to_state_dict, which asserts
+        # string keys on any dict-typed attribute, so module.init/clone
+        # crashed on the raw dict (the seed-inherited tier-1 failure).
+        # Normalize to a hashable tuple of (degree, channels) pairs at
+        # construction; Fiber() accepts the pair form directly.
+        for field in ('hidden_fiber_dict', 'out_fiber_dict'):
+            val = getattr(self, field)
+            if val is not None and not isinstance(val, tuple):
+                object.__setattr__(
+                    self, field,
+                    tuple(sorted((int(d), int(c)) for d, c in val.items())))
+        super().__post_init__()
 
     # ------------------------------------------------------------------ #
     # static configuration helpers (resolved at trace time)
@@ -153,7 +183,7 @@ class SE3TransformerModule(nn.Module):
         assert self.num_degrees is not None or self.hidden_fiber_dict is not None, \
             'either num_degrees or hidden_fiber_dict must be specified'
         num_degrees = self.num_degrees if self.num_degrees is not None \
-            else (max(self.hidden_fiber_dict.keys()) + 1)
+            else (max(d for d, _ in self.hidden_fiber_dict) + 1)
 
         dim_in = self.dim_in if self.dim_in is not None else self.dim
         fiber_in = Fiber.create(self.input_degrees,
@@ -168,7 +198,7 @@ class SE3TransformerModule(nn.Module):
         dim_out = self.dim_out if self.dim_out is not None else self.dim
         if self.out_fiber_dict is not None:
             fiber_out = Fiber(self.out_fiber_dict)
-            output_degrees = max(self.out_fiber_dict.keys()) + 1
+            output_degrees = max(d for d, _ in self.out_fiber_dict) + 1
         elif output_degrees is not None:
             fiber_out = Fiber.create(output_degrees, dim_out)
         else:
@@ -253,53 +283,89 @@ class SE3TransformerModule(nn.Module):
                 f"unknown sequence_parallel mode {self.sequence_parallel!r}"
             assert self.mesh is not None, \
                 'sequence_parallel requires a mesh (jax.sharding.Mesh)'
+            import contextlib
+
+            from ..parallel.exchange import (
+                bonded_priority_mask, exchange_scope, neighbor_gather,
+                rowwise_gather,
+            )
             from ..parallel.ring import ring_knn
 
+            # row-local bonded-mask construction (exchange.py): the
+            # dense scatter+top-k build would cost a full-width
+            # [b, n, n] all-gather under GSPMD
+            sp_size = self.mesh.shape.get('sp', 1)
+            bonded_fn = partial(bonded_priority_mask, mesh=self.mesh) \
+                if self.ring_exchange and n % sp_size == 0 else None
             adj_mat, adj_ind_full, sp_full, num_sparse = \
-                self._adjacency_predicates(adj_mat, b, n)
+                self._adjacency_predicates(adj_mat, b, n,
+                                           bonded_fn=bonded_fn)
             total_neighbors = int(min(num_neighbors + num_sparse, n - 1))
             assert total_neighbors > 0, 'must fetch at least 1 neighbor'
 
             rank, idx = ring_knn(
                 coors, total_neighbors, self.mesh, mask=mask,
                 neighbor_mask=neighbor_mask, sparse_mask=sp_full,
-                causal=self.causal)
+                causal=self.causal, overlap=self.ring_overlap)
             # the dense validity rule on the MODIFIED ranking: bonded
             # slots (rank 0) stay valid beyond the radius, masked/future
             # slots (rank FINF) never validate (neighbors.py:150)
             valid_radius = self.valid_radius if num_neighbors > 0 else 0.
             valid = rank <= valid_radius
-            coors_j = batched_index_select(coors, idx, axis=1)
+            # neighbor-sparse exchange (parallel/exchange.py): the ids
+            # are GLOBAL, so a plain gather over the node-sharded
+            # operands would make GSPMD all-gather the full [b, N, ...]
+            # tensor onto every device — the exchange rotates owned
+            # blocks instead (O(n_local) resident, overlap-capable).
+            # ring_exchange=False keeps the dense gathers (bit-exact A/B
+            # control arm).
+            if self.ring_exchange:
+                gather_nodes = partial(neighbor_gather, mesh=self.mesh,
+                                       overlap=self.ring_overlap)
+                gather_cols = partial(rowwise_gather, mesh=self.mesh)
+            else:
+                gather_nodes = partial(batched_index_select, axis=1)
+                gather_cols = partial(batched_index_select, axis=2)
+            coors_j = gather_nodes(coors, idx)
             nbr_rel_pos = coors[:, :, None, :] - coors_j
             nbr_rel_dist = safe_norm(nbr_rel_pos, axis=-1)
             if mask is not None:
-                valid = valid & batched_index_select(mask, idx, axis=1)
+                valid = valid & gather_nodes(mask, idx)
                 valid = valid & mask[:, :, None]
             hood = Neighborhood(idx, valid, nbr_rel_pos, nbr_rel_dist)
 
             # edges gather by the GLOBAL neighbor ids (the dense path's
             # remove_self + nearest-gather composed; reference
-            # :1231-1239). Token edges gather FIRST and embed the
-            # [b, n, k] selection — embedding the full [b, n, n] layout
-            # would materialize the O(n^2 * edge_dim) tensor this path
-            # exists to avoid (Embed is pointwise, so the values match)
+            # :1231-1239). The [b, n, N, ...] operands are row-sharded
+            # with full columns, so the column selection is zero-comm —
+            # rowwise_gather pins it local under shard_map. Token edges
+            # gather FIRST and embed the [b, n, k] selection — embedding
+            # the full [b, n, n] layout would materialize the
+            # O(n^2 * edge_dim) tensor this path exists to avoid (Embed
+            # is pointwise, so the values match)
             if edges is not None:
                 if self.num_edge_tokens is not None:
-                    edges = batched_index_select(edges, idx, axis=2)
+                    edges = gather_cols(edges, idx)
                     edges = nn.Embed(self.num_edge_tokens, self.edge_dim,
                                      name='edge_emb')(edges)
                 else:
-                    edges = batched_index_select(edges, idx, axis=2)
+                    edges = gather_cols(edges, idx)
             if self.num_adj_degrees is not None and self.adj_dim > 0:
-                adj_sel = jnp.take_along_axis(adj_ind_full, idx, axis=2)
+                adj_sel = gather_cols(adj_ind_full, idx)
                 adj_emb = nn.Embed(self.num_adj_degrees + 1, self.adj_dim,
                                    name='adj_emb')(adj_sel)
                 edges = jnp.concatenate((edges, adj_emb), axis=-1) \
                     if edges is not None else adj_emb
 
-            return self._body(feats, hood, edges, mask, global_feats,
-                              return_type, return_pooled, num_degrees,
-                              fiber_in, fiber_hidden, fiber_out, b, n)
+            # the trunk's per-layer neighbor feature gathers (ConvSE3 /
+            # attention / EGNN select values at hood.indices) route
+            # through the same sparse exchange while the scope is active
+            scope = exchange_scope(self.mesh, overlap=self.ring_overlap) \
+                if self.ring_exchange else contextlib.nullcontext()
+            with scope:
+                return self._body(feats, hood, edges, mask, global_feats,
+                                  return_type, return_pooled, num_degrees,
+                                  fiber_in, fiber_hidden, fiber_out, b, n)
 
         # precomputed neighborhoods (host C++ kNN via native.knn_graph, or
         # ring kNN via parallel.ring) replace the O(n^2) on-device
@@ -387,7 +453,7 @@ class SE3TransformerModule(nn.Module):
                           return_type, return_pooled, num_degrees,
                           fiber_in, fiber_hidden, fiber_out, b, n)
 
-    def _adjacency_predicates(self, adj_mat, b, n):
+    def _adjacency_predicates(self, adj_mat, b, n, bonded_fn=None):
         """Full-[b, n, n]-layout adjacency products shared by the dense
         and ring branches: (expanded adj_mat, N-hop ring labels, bonded
         sparse-priority mask, num_sparse). Reference :1177-1217.
@@ -400,7 +466,14 @@ class SE3TransformerModule(nn.Module):
         the caller threads an rng (apply(..., rngs={'neighbor_noise':
         key}), matching the reference's per-forward draw :1211);
         deterministic seed-0 otherwise so plain inference stays
-        reproducible."""
+        reproducible.
+
+        bonded_fn(adj_mat, noise_n1, num_sparse) -> sp_full, when given,
+        replaces the dense scatter+top-k construction — the ring branch
+        passes parallel.exchange.bonded_priority_mask so the build stays
+        row-local (GSPMD's scatter partitioner otherwise re-materializes
+        the full [b, n, n] operand per device; same rng draw, exact
+        parity)."""
         if adj_mat is not None and adj_mat.ndim == 2:
             adj_mat = jnp.broadcast_to(adj_mat[None], (b, n, n))
         adj_ind_full = None
@@ -417,16 +490,19 @@ class SE3TransformerModule(nn.Module):
                 if self.has_rng('neighbor_noise') else jax.random.PRNGKey(0)
             noise_n1 = jax.random.uniform(
                 noise_key, (b, n, n - 1), minval=-0.01, maxval=0.01)
-            self_excl = exclude_self_indices(n)
-            noise_full = jnp.zeros((b, n, n), noise_n1.dtype).at[
-                :, jnp.arange(n)[:, None], self_excl].set(noise_n1)
-            adj_noself = adj_mat.astype(bool) \
-                & ~jnp.eye(n, dtype=bool)[None]
-            # the diagonal carries value 0 (+0 noise) and the >0.5
-            # bonded threshold filters it, so the full-layout selection
-            # equals remove_self of the dense one exactly
-            sp_full = sparse_neighbor_mask(adj_noself, num_sparse,
-                                           noise_full)
+            if bonded_fn is not None:
+                sp_full = bonded_fn(adj_mat, noise_n1, num_sparse)
+            else:
+                self_excl = exclude_self_indices(n)
+                noise_full = jnp.zeros((b, n, n), noise_n1.dtype).at[
+                    :, jnp.arange(n)[:, None], self_excl].set(noise_n1)
+                adj_noself = adj_mat.astype(bool) \
+                    & ~jnp.eye(n, dtype=bool)[None]
+                # the diagonal carries value 0 (+0 noise) and the >0.5
+                # bonded threshold filters it, so the full-layout
+                # selection equals remove_self of the dense one exactly
+                sp_full = sparse_neighbor_mask(adj_noself, num_sparse,
+                                               noise_full)
         return adj_mat, adj_ind_full, sp_full, num_sparse
 
     def _body(self, feats, hood, edges, mask, global_feats, return_type,

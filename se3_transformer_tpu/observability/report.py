@@ -114,7 +114,7 @@ def summarize_telemetry(records: List[dict],
         if rid not in runs:
             runs[rid] = dict(meta=None, flushes=[], summary=None,
                              retrace_warnings=0, steps=[], pipeline=None,
-                             tune=[])
+                             tune=[], comm=[])
             order.append(rid)
         kind = rec.get('kind')
         if kind == 'run_meta':
@@ -132,6 +132,10 @@ def summarize_telemetry(records: List[dict],
             runs[rid]['pipeline'] = rec
         elif kind == 'tune':
             runs[rid]['tune'].append(rec)
+        elif kind == 'comm':
+            # one per traced program; an A/B run carries several (the
+            # overlapped and serialized arms), all surfaced
+            runs[rid]['comm'].append(rec)
 
     out = []
     for rid in order:
@@ -185,6 +189,8 @@ def summarize_telemetry(records: List[dict],
                                if k in pipe}
         if run['tune']:
             rec['kernel_tuning'] = summarize_tune_records(run['tune'])
+        if run['comm']:
+            rec['comm'] = summarize_comm_records(run['comm'])
         out.append(rec)
     return out
 
@@ -209,6 +215,64 @@ def summarize_tune_records(records: List[dict]) -> dict:
         for r in tunes if r.get('verdict') == 'consulted']
     return dict(candidates=len(tunes), verdicts=verdicts,
                 promoted=promoted, consulted=consulted)
+
+
+def write_comm_stream(path: str, run_id: str,
+                      comm_bodies: List[dict]) -> List[dict]:
+    """Schema-valid JSONL telemetry stream for a comm-accounting run:
+    one run_meta header + one kind='comm' record per body (each a
+    `parallel.exchange.comm_payload` dict, optionally already carrying
+    label/step_s). Every record is validated before anything is
+    written — `make ring-smoke` and `width_table --weak-scaling` both
+    route their streams through here, so a schema change breaks loudly
+    in exactly one place."""
+    import os
+    import platform
+    import socket
+
+    from .schema import SCHEMA_VERSION, validate_record
+
+    records = [dict(kind='run_meta', run_id=run_id,
+                    schema_version=SCHEMA_VERSION, backend='cpu',
+                    code_rev=os.environ.get('SE3_TPU_CODE_REV', 'dev'),
+                    host=dict(hostname=socket.gethostname(),
+                              pid=os.getpid(),
+                              python=platform.python_version()))]
+    records += [dict(kind='comm', run_id=run_id, **body)
+                for body in comm_bodies]
+    for r in records:
+        validate_record(r)
+    with open(path, 'w') as f:
+        for r in records:
+            f.write(json.dumps(r) + '\n')
+    return records
+
+
+def summarize_comm_records(records: List[dict]) -> dict:
+    """Reduce comm records (parallel.exchange.comm_payload rows) to the
+    view the run report surfaces: per-arm {overlap, exchange, collective
+    counts/bytes} plus the aggregate all-gather-free verdict (true only
+    when EVERY exchange-enabled arm traced clean — the serialized/dense
+    control arm of an A/B is allowed its gathers, that is its point)."""
+    comms = [r for r in records if r.get('kind', 'comm') == 'comm']
+    arms = []
+    for r in comms:
+        arm = {k: r[k] for k in ('sp', 'ring_steps', 'overlap', 'exchange',
+                                 'all_gather_free', 'step_s', 'label')
+               if k in r}
+        arm['collectives'] = {
+            cls: dict(count=st.get('count'), bytes=st.get('bytes'))
+            for cls, st in (r.get('collectives') or {}).items()}
+        if r.get('full_width_all_gathers'):
+            arm['full_width_all_gathers'] = r['full_width_all_gathers']
+        arms.append(arm)
+    exchange_arms = [a for a in arms if a.get('exchange')]
+    return dict(
+        programs=len(arms),
+        arms=arms,
+        all_gather_free=bool(exchange_arms) and all(
+            a.get('all_gather_free') for a in exchange_arms),
+    )
 
 
 def summarize(records: List[dict], anchor: Optional[float] = None,

@@ -38,6 +38,16 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    + promoted (bool). Promotion evidence must be
                    END-TO-END — the schema cannot check that, but the
                    tuner records the pairs so a reviewer can.
+  comm             sequence-parallel communication accounting for one
+                   traced program (parallel.exchange.comm_payload): ring
+                   configuration {sp, ring_steps, overlap, exchange},
+                   per-collective-class {count, bytes} from the compiled
+                   HLO text, and the load-bearing pair:
+                   full_width_all_gathers (the [b, N, ...] gathers the
+                   neighbor-sparse exchange exists to kill — shapes, so
+                   a violation is diagnosable from the record alone) +
+                   all_gather_free (bool — `make ring-smoke` gates on
+                   it for the sp>1 exchange arm).
   summary          end-of-run cumulative record (metrics, timing,
                    nodes_steps_per_sec, loss trajectory,
                    retrace_warnings_total).
@@ -54,7 +64,7 @@ from typing import Iterable, Union
 SCHEMA_VERSION = 1
 
 KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
-               'serve', 'tune', 'summary')
+               'serve', 'tune', 'comm', 'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
@@ -74,6 +84,11 @@ _REQUIRED = {
     # candidate (and whether the table changed) proves nothing
     'tune': ('run_id', 'kernel', 'shape', 'candidate', 'blocks', 'verdict',
              'promoted'),
+    # all_gather_free is the load-bearing field of the neighbor-sparse
+    # exchange contract: a comm record that cannot say whether the
+    # traced program re-materialized a full-width operand proves nothing
+    'comm': ('run_id', 'sp', 'ring_steps', 'overlap', 'exchange',
+             'collectives', 'full_width_all_gathers', 'all_gather_free'),
     'summary': ('run_id', 'steps', 'metrics', 'timing'),
 }
 
@@ -158,6 +173,31 @@ def validate_record(rec: dict, index=None) -> dict:
                     not all(isinstance(v, int) for v in val):
                 _fail(index, f'tune.{field} must be a list of ints, '
                              f'got {val!r}')
+    if kind == 'comm':
+        for field in ('overlap', 'exchange', 'all_gather_free'):
+            if not isinstance(rec[field], bool):
+                _fail(index, f'comm.{field} must be a bool, got '
+                             f'{rec[field]!r}')
+        for field in ('sp', 'ring_steps'):
+            if not isinstance(rec[field], int) or rec[field] < 1:
+                _fail(index, f'comm.{field} must be a positive int, got '
+                             f'{rec[field]!r}')
+        colls = rec['collectives']
+        if not isinstance(colls, dict):
+            _fail(index, 'comm.collectives must be an object')
+        for cls, st in colls.items():
+            missing = [k for k in ('count', 'bytes')
+                       if not isinstance(st, dict) or k not in st]
+            if missing:
+                _fail(index, f'collectives[{cls!r}] missing {missing} '
+                             f'(per-class count+bytes are the whole '
+                             f'accounting)')
+        if not isinstance(rec['full_width_all_gathers'], list):
+            _fail(index, 'comm.full_width_all_gathers must be a list '
+                         '(the offending shapes, empty when clean)')
+        if rec['all_gather_free'] and rec['full_width_all_gathers']:
+            _fail(index, 'comm.all_gather_free=true contradicts a '
+                         'non-empty full_width_all_gathers list')
     if kind in ('flush', 'summary'):
         timing = rec['timing']
         if not isinstance(timing, dict):

@@ -35,6 +35,12 @@ MODEL_SCOPES = (
     'attn_core',          # ops/attention.py — sim/softmax/weighted sum
     'pallas_attention',   # kernels/pallas_attention.py — fused kernel
     'ring_knn',           # parallel/ring.py — sequence-parallel kNN
+    'ici_wait',           # parallel/ring.py ring_scan — the ppermute hop;
+    #                       in an overlapped trace its exclusive time is
+    #                       the NON-hidden remainder of the transfer
+    'exchange',           # parallel/exchange.py — neighbor-sparse value
+    #                       rotation + select (and the zero-comm rowwise
+    #                       column select)
 )
 
 

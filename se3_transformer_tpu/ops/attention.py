@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 
 from ..observability import named_scope
-from ..utils.helpers import batched_index_select, to_order
+from ..parallel.exchange import exchange_index_select
+from ..utils.helpers import to_order
 from .conv import ConvSE3, EdgeInfo
 from .core import LinearSE3, NormSE3, residual_se3
 from .fiber import Fiber
@@ -98,7 +99,7 @@ class AttentionSE3(nn.Module):
 
             if self.linear_proj_keys:
                 keys = LinearSE3(self.fiber, kv_fiber, name='to_k')(features)
-                keys = {d: batched_index_select(v, neighbor_indices, axis=1)
+                keys = {d: exchange_index_select(v, neighbor_indices, axis=1)
                         for d, v in keys.items()}
             elif self.tie_key_values:
                 keys = values

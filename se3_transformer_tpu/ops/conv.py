@@ -30,9 +30,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..utils.helpers import (
-    batched_index_select, fourier_encode, masked_mean, to_order,
-)
+from ..parallel.exchange import exchange_index_select
+from ..utils.helpers import fourier_encode, masked_mean, to_order
 from .core import LinearSE3, residual_se3
 from .fiber import Fiber
 
@@ -516,10 +515,13 @@ class ConvSE3(nn.Module):
             edge_features = jnp.concatenate((rel_dist_feats, edges), axis=-1)
 
         # gather neighbor features once per input degree
+        # (exchange_index_select: under the ring branch's exchange scope
+        # this is the neighbor-sparse ring rotation; a plain dense gather
+        # everywhere else — parallel/exchange.py)
         gathered = {}
         for degree_in, _ in self.fiber_in:
             key = str(degree_in)
-            gathered[key] = batched_index_select(
+            gathered[key] = exchange_index_select(
                 inp[key], neighbor_indices, axis=1)  # [b, n, k, c_in, 2di+1]
 
         hidden = radial_hidden(

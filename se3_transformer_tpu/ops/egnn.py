@@ -21,7 +21,8 @@ from typing import Dict, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from ..utils.helpers import batched_index_select, broadcat, safe_norm
+from ..parallel.exchange import exchange_index_select
+from ..utils.helpers import broadcat, safe_norm
 from .conv import EdgeInfo
 from .core import FeedForwardBlockSE3
 from .fiber import Fiber
@@ -82,13 +83,13 @@ class EGNN(nn.Module):
         rel_htypes = {}
         rel_htype_dists = []
         for degree, htype in htype_items:
-            nbr = batched_index_select(htype, neighbor_indices, axis=1)
+            nbr = exchange_index_select(htype, neighbor_indices, axis=1)
             rel = htype[:, :, None] - nbr            # [b, n, k, c, m]
             rel_htypes[degree] = rel
             rel_htype_dists.append(safe_norm(rel, axis=-1))
 
         nodes_i = nodes[:, :, None]                   # [b, n, 1, d]
-        nodes_j = batched_index_select(nodes, neighbor_indices, axis=1)
+        nodes_j = exchange_index_select(nodes, neighbor_indices, axis=1)
         coor_rel_dist = rel_dist[..., None]           # [b, n, k, 1]
 
         edge_mlp_inputs = broadcat(

@@ -1,6 +1,10 @@
 from .mesh import make_mesh, shard_batch, data_specs, MESH_AXES
 from . import distributed
 from .ring import ring_knn, dense_knn
+from .exchange import (
+    analyze_hlo_comm, bonded_priority_mask, comm_payload,
+    exchange_index_select, exchange_scope, neighbor_gather, rowwise_gather,
+)
 from .sharding import (
     make_sharded_train_step, make_accumulating_train_step, replicated,
     param_partition_specs, shard_params,

@@ -85,7 +85,9 @@ def train_step_flops_estimate(module, n: int, k: int, batch: int = 1
     # win when num_degrees is None (models/se3_transformer.py)
     num_degrees = module.num_degrees
     if num_degrees is None and module.hidden_fiber_dict is not None:
-        num_degrees = max(int(d) for d in module.hidden_fiber_dict) + 1
+        # the module normalizes fiber dicts to (degree, channels) pairs
+        # at construction (flax state-dict string-key constraint)
+        num_degrees = max(Fiber(module.hidden_fiber_dict).degrees) + 1
     dim = module.dim
     hidden = Fiber.create(num_degrees, dim) \
         if module.hidden_fiber_dict is None \

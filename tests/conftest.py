@@ -170,6 +170,16 @@ _SLOW_TESTS = {
     # test_radial_bf16: full fast-path model programs
     'test_differentiable_coors_with_full_fast_path',
     'test_radial_bf16_pallas_paths_match_xla',
+    # test_exchange (PR 5): the model-level exchange-vs-dense-gather
+    # arms compile two full ring-path programs each under the simulated
+    # mesh (the gather-level parity tests stay tier-1)
+    'test_ring_exchange_model_matches_dense_gathers',
+    'test_ring_exchange_model_matches_dense_gathers_causal',
+    # test_multihost (PR 5): the 2-process jax.distributed sim hung
+    # >300 s in-round (tier-1 wall budget is 870 s) — the test now
+    # carries a hard overall deadline, but a distributed-runtime smoke
+    # has no place in the timed gate either way
+    'test_two_process_distributed_batch_assembly',
 }
 
 

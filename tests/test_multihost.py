@@ -15,6 +15,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 
 CHILD = r'''
 import os, sys
@@ -77,11 +78,24 @@ def test_two_process_distributed_batch_assembly(tmp_path):
         [sys.executable, str(child), str(i), port], cwd=here, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for i in range(2)]
+    # ONE shared deadline for the whole cluster, not a fresh 240 s per
+    # child: a wedged coordinator hangs BOTH children, and sequential
+    # communicate() timeouts used to stack past the suite's wall budget
+    # (observed >300 s before the hang was even reported)
+    deadline = time.monotonic() + 240
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            remaining = max(1.0, deadline - time.monotonic())
+            out, _ = p.communicate(timeout=remaining)
             outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise AssertionError(
+            'distributed 2-process sim exceeded the 240 s cluster '
+            'deadline (coordinator wedge?); partial output: '
+            f'{[o[-500:] for o in outs]}')
     finally:
         for p in procs:
             p.kill()

@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke pipeline-smoke tune-smoke ring-smoke clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke perf-gate clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -48,6 +48,15 @@ ring-smoke:        ## virtual-8-device sequence-parallel comm gate (docs/PERFORM
 	rm -f /tmp/ring_smoke.jsonl
 	python scripts/ring_smoke.py --metrics /tmp/ring_smoke.jsonl
 	python scripts/obs_report.py /tmp/ring_smoke.jsonl --validate --require-comm --out /tmp/ring_smoke_summary.json
+
+profile-smoke:     ## toy trace -> per-scope device-time attribution (docs/PERFORMANCE.md "Reading rooflines"): exits non-zero unless MODEL_SCOPES cover >=80% of device time AND the cost/profile records are schema-valid
+	rm -f /tmp/profile_smoke.jsonl
+	python scripts/profile_smoke.py --metrics /tmp/profile_smoke.jsonl --min-coverage 0.8
+	python scripts/obs_report.py /tmp/profile_smoke.jsonl --validate --require cost,profile --out /tmp/profile_smoke_summary.json
+
+perf-gate:         ## committed budgets vs the evidence streams (docs/PERFORMANCE.md "The perf gate"): must PASS on the current tree, then must FIRE on an injected synthetic regression
+	python scripts/perf_gate.py --fresh-cost /tmp/perf_gate_cost.jsonl
+	python scripts/perf_gate.py /tmp/perf_gate_cost.jsonl --inject-regression >/tmp/perf_gate_inject.log 2>&1; test $$? -eq 1 || { echo "perf-gate injection arm did NOT fire with rc=1 — gate output:"; cat /tmp/perf_gate_inject.log; exit 1; }  # rc=1 is the gate FIRING; any other rc (argparse error, crash) fails loudly with the evidence
 
 tpu-checks:        ## on-chip equivariance + kernel numerics/speed gate
 	python scripts/tpu_checks.py

@@ -341,20 +341,25 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None,
     key = jax.random.PRNGKey(1)
 
     # AOT-compile once: the same executable serves the FLOP count (MFU
-    # estimate) and the benchmark loop — lower().compile() does not
-    # populate the jit cache, so executing `step` afterwards would
-    # compile the multi-minute flagship program a second time
+    # estimate), the cost ledger, and the benchmark loop —
+    # lower().compile() does not populate the jit cache, so executing
+    # `step` afterwards would compile the multi-minute flagship program
+    # a second time
     step_flops = None
+    cost_body = None
     exec_fn = step
     try:
         compiled = step.lower(params, opt_state, data, key).compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        step_flops = float(cost.get('flops', 0.0)) or None
         exec_fn = compiled
-    except Exception:
-        pass
+        from se3_transformer_tpu.observability.costs import cost_payload
+        cost_body = cost_payload(compiled, label=label)
+        step_flops = cost_body['flops'] \
+            if cost_body['source'] == 'cost_analysis' else None
+    except Exception as e:
+        # the ledger must never cost the timing: a cost/introspection
+        # failure falls back to the uninstrumented jit path
+        print(f'bench: cost introspection unavailable '
+              f'({type(e).__name__}: {e})', file=sys.stderr)
 
     # warmup (fetch_sync: an early-returning block here would leak
     # warmup work into the timed window)
@@ -627,6 +632,19 @@ def main(backend: str, fast=None, fast_fallback=False, fallback_reason=None,
         record['retrace_post_warmup'] = retrace_post_warmup
     if hbm_peak_bytes is not None:
         record['hbm_peak_bytes'] = hbm_peak_bytes
+    if cost_body is not None:
+        # the schema'd `cost` payload (observability.costs): the
+        # BENCH_*.json trajectory tracks peak memory alongside
+        # nodes*steps/s, and scripts/perf_gate.py budgets both.
+        # peak_hbm_bytes is XLA's static argument+output+temp estimate;
+        # hbm_peak_bytes above stays the watchdog's MEASURED figure
+        # where the backend reports one. The label is re-stamped here
+        # because the pipelined arm appends ',pipelined' AFTER the
+        # ledger captured the base label — a cost record must name the
+        # arm it measured
+        cost_body['label'] = label
+        record['cost'] = cost_body
+        record['peak_hbm_bytes'] = cost_body['peak_bytes']
     # loss-trajectory sanity: adam at 1e-4 on this objective decreases
     # monotonically-ish from the first step; a flat or garbage sequence
     # means the executable did not run the program the label claims.
@@ -733,6 +751,7 @@ def ring_main(n_devices: int, per_device_nodes: int = None):
             arms['serialized_dense']['step_s'] / fast_arm['step_s'], 3),
         'per_shard_total_gb': fast_arm.get('per_shard_total_gb'),
         'comm': {arm: rec.get('comm') for arm, rec in arms.items()},
+        'cost': {arm: rec.get('cost') for arm, rec in arms.items()},
         'loss_finite': bool(fast_arm.get('loss_finite')
                             and arms['serialized_dense'].get('loss_finite')),
     }

@@ -53,6 +53,12 @@ def main():
                          '(gate: make pipeline-smoke)')
     ap.add_argument('--prefetch-depth', type=int, default=2,
                     help='device-resident batches ahead of the step loop')
+    ap.add_argument('--cost-record', action='store_true',
+                    help='emit one schema\'d `cost` record for the '
+                         'compiled train step after the first step '
+                         '(observability.costs: flops, peak memory '
+                         'split, collective bytes; pair with --metrics '
+                         '— scripts/perf_gate.py budgets the stream)')
     ap.add_argument('--dataset', type=str, default=None,
                     help='train from a PointCloudDataset .npz (see '
                          'training.dataset); --nodes becomes the bucket size')
@@ -72,6 +78,7 @@ def main():
                         flush_every=args.flush_every,
                         pipeline=args.pipelined,
                         prefetch_depth=args.prefetch_depth,
+                        cost_record=args.cost_record,
                         # every pipelined batch is freshly placed by
                         # device_prefetch, so donation is safe (see the
                         # audit in parallel.sharding)
@@ -109,7 +116,10 @@ def main():
                 # without --telemetry the per-step records still land in
                 # --metrics (same shape as the synchronous path)
                 log=lambda msg: logger.log(trainer.step_count, msg=msg),
-                metric_logger=logger if cfg.telemetry else None,
+                # cost_record also needs the stream (one cost record
+                # after the first step), telemetry or not
+                metric_logger=logger
+                if (cfg.telemetry or cfg.cost_record) else None,
                 checkpoint_manager=ckpt, checkpoint_every=args.ckpt_every)
         elif args.dataset:
             from se3_transformer_tpu.training.dataset import (
@@ -137,6 +147,10 @@ def main():
                         batch = next(stream)
                 else:
                     batch = next(stream)
+                if i == 0:
+                    # this branch drives train_step directly, so the
+                    # trainer's own first-step ledger hook never runs
+                    trainer._maybe_cost_record(batch, logger, history)
                 loss = trainer.train_step(batch)
                 if cfg.telemetry:
                     # no per-step float(): metrics accumulate on device
@@ -162,7 +176,8 @@ def main():
                                     checkpoint_manager=ckpt,
                                     checkpoint_every=args.ckpt_every,
                                     metric_logger=logger
-                                    if cfg.telemetry else None)
+                                    if (cfg.telemetry or cfg.cost_record)
+                                    else None)
         if ckpt is not None:
             ckpt.save(trainer.step_count,
                       (trainer.params, trainer.opt_state,

@@ -3,7 +3,11 @@
 Usage:
     python scripts/obs_report.py STREAM.jsonl [MORE.jsonl ...]
         [--validate] [--out SUMMARY.json] [--anchor FLOAT]
-        [--code-rev REV]
+        [--code-rev REV] [--require kind[,kind...]]
+
+--require gates the stream on record kinds (pipeline / comm / tune /
+cost / profile), each with its load-bearing check; the old
+--require-pipeline/--require-comm/--require-tune flags are aliases.
 
 Input species are auto-detected per record:
   * bench records ({"metric", "value", "unit", ...} — BENCH_SESSION.jsonl,
@@ -36,6 +40,125 @@ from se3_transformer_tpu.observability.schema import (  # noqa: E402
 )
 
 
+def _gate_pipeline(records):
+    pipes = [r for r in records if r.get('kind') == 'pipeline']
+    if not pipes:
+        print('PIPELINE GATE: no pipeline records in the stream '
+              '(was the run started with --pipelined?)', file=sys.stderr)
+        return False
+    last = pipes[-1].get('prefetch', {})
+    hits, stalls = last.get('hits', 0), last.get('stalls', 0)
+    if not hits:
+        print(f'PIPELINE GATE: 100% prefetch stalls ({stalls} stalls, '
+              f'0 hits) — the producer never got ahead of the device',
+              file=sys.stderr)
+        return False
+    print(f'pipeline gate ok: {hits} hits / {stalls} stalls, '
+          f'verdict {pipes[-1].get("verdict")}', file=sys.stderr)
+    return True
+
+
+def _gate_comm(records):
+    comms = [r for r in records if r.get('kind') == 'comm']
+    if not comms:
+        print('COMM GATE: no comm records in the stream (was the run '
+              'traced with the exchange instrumented?)', file=sys.stderr)
+        return False
+    ex_arms = [r for r in comms if r.get('exchange')]
+    if not ex_arms:
+        print('COMM GATE: no exchange-enabled comm record — the '
+              'sparse path was never traced', file=sys.stderr)
+        return False
+    dirty = [r for r in ex_arms if not r.get('all_gather_free')]
+    if dirty:
+        shapes = [s for r in dirty
+                  for s in r.get('full_width_all_gathers', [])]
+        print(f'COMM GATE: {len(dirty)} exchange-enabled program(s) '
+              f'still carry full-width all-gathers: {shapes}',
+              file=sys.stderr)
+        return False
+    print(f'comm gate ok: {len(comms)} comm records, '
+          f'{len(ex_arms)} exchange arms, all all-gather-free',
+          file=sys.stderr)
+    return True
+
+
+def _gate_tune(records):
+    tunes = [r for r in records if r.get('kind') == 'tune']
+    if not tunes:
+        print('TUNE GATE: no tune records in the stream (was '
+              'scripts/tune_kernels.py run?)', file=sys.stderr)
+        return False
+    promoted = [r for r in tunes if r.get('verdict') == 'promoted']
+    consulted = [r for r in tunes if r.get('verdict') == 'consulted']
+    if not promoted:
+        print('TUNE GATE: no candidate was promoted', file=sys.stderr)
+        return False
+    if not consulted:
+        print('TUNE GATE: no consulted verdict — the promoted entry '
+              'was never proven to steer a subsequent pick',
+              file=sys.stderr)
+        return False
+    print(f'tune gate ok: {len(tunes)} tune records, '
+          f'{len(promoted)} promoted, {len(consulted)} consulted',
+          file=sys.stderr)
+    return True
+
+
+def _gate_cost(records):
+    costs = [r for r in records if r.get('kind') == 'cost']
+    if not costs:
+        print('COST GATE: no cost records in the stream (was the run '
+              'ledgered — bench cost payload, engine warmup, '
+              '--cost-record?)', file=sys.stderr)
+        return False
+    empty = [r for r in costs if not r.get('peak_bytes')]
+    if empty:
+        labels = [r.get('label') for r in empty]
+        print(f'COST GATE: {len(empty)} cost record(s) with zero peak '
+              f'memory — the ledger measured nothing: {labels}',
+              file=sys.stderr)
+        return False
+    unavailable = [r.get('label') for r in costs
+                   if r.get('source') == 'unavailable']
+    if unavailable:
+        print(f'COST GATE: source=unavailable for {unavailable} — '
+              f'neither cost_analysis nor the HLO fallback produced '
+              f'numbers', file=sys.stderr)
+        return False
+    print(f'cost gate ok: {len(costs)} cost records, peak '
+          f'{max(r["peak_bytes"] for r in costs) / 2**20:.1f} MiB max',
+          file=sys.stderr)
+    return True
+
+
+def _gate_profile(records):
+    profs = [r for r in records if r.get('kind') == 'profile']
+    if not profs:
+        print('PROFILE GATE: no profile records in the stream (was a '
+              'trace captured and attributed — make profile-smoke?)',
+              file=sys.stderr)
+        return False
+    dead = [r.get('label') for r in profs
+            if not r.get('device_time_ms') or not r.get('scopes')]
+    if dead:
+        print(f'PROFILE GATE: profile record(s) with no device time or '
+              f'no scopes: {dead} — the trace attributed nothing',
+              file=sys.stderr)
+        return False
+    worst = min(r.get('coverage', 0) for r in profs)
+    print(f'profile gate ok: {len(profs)} profile records, worst '
+          f'coverage {worst:.0%} (the >=80% bar is enforced where the '
+          f'trace is captured: scripts/profile_smoke.py)',
+          file=sys.stderr)
+    return True
+
+
+_REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
+                      tune=_gate_tune, cost=_gate_cost,
+                      profile=_gate_profile)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description='aggregate telemetry/bench JSONL into one summary')
@@ -49,25 +172,38 @@ def main(argv=None):
                     help='vs_baseline anchor for telemetry throughput')
     ap.add_argument('--code-rev', default=None,
                     help='only summarize bench records with this code_rev')
+    ap.add_argument('--require', default=None, metavar='KIND[,KIND...]',
+                    help='gate the stream on record kinds: '
+                         f'{sorted(_REQUIRE_GATES)}. Each kind runs its '
+                         'load-bearing check (pipeline: >=1 prefetch '
+                         'hit; comm: every exchange arm all-gather-'
+                         'free; tune: a promotion that is consulted; '
+                         'cost: every program ledgers nonzero peak '
+                         'memory; profile: per-scope attribution '
+                         'present with its coverage figure) and exits '
+                         'non-zero on failure')
+    # legacy aliases for the unified --require flag (kept: Makefiles and
+    # session scripts in the wild still pass them)
     ap.add_argument('--require-tune', action='store_true',
-                    help='gate a kernel-tuning run (make tune-smoke): '
-                         'exit non-zero unless the stream carries at '
-                         'least one `tune` record, at least one '
-                         'promotion, and a `consulted` verdict proving '
-                         'the promoted entry steered the next pick')
+                    help='alias for --require tune')
     ap.add_argument('--require-comm', action='store_true',
-                    help='gate a sequence-parallel run (make ring-smoke): '
-                         'exit non-zero unless the stream carries at '
-                         'least one `comm` record with exchange=true, '
-                         'and every such record proves the traced '
-                         'program free of full-width all-gathers')
+                    help='alias for --require comm')
     ap.add_argument('--require-pipeline', action='store_true',
-                    help='gate a pipelined run: exit non-zero unless the '
-                         'stream carries at least one `pipeline` record '
-                         'whose final cumulative counters show at least '
-                         'one prefetch hit (a 100%% stall rate means the '
-                         'pipeline never overlapped anything)')
+                    help='alias for --require pipeline')
     args = ap.parse_args(argv)
+
+    required = {k.strip() for k in (args.require or '').split(',')
+                if k.strip()}
+    for kind, legacy_on in (('tune', args.require_tune),
+                            ('comm', args.require_comm),
+                            ('pipeline', args.require_pipeline)):
+        if legacy_on:
+            required.add(kind)
+    unknown = required - set(_REQUIRE_GATES)
+    if unknown:
+        print(f'unknown --require kinds {sorted(unknown)} '
+              f'(known: {sorted(_REQUIRE_GATES)})', file=sys.stderr)
+        return 2
 
     records = []
     for path in args.paths:
@@ -87,66 +223,9 @@ def main(argv=None):
         print('no records found', file=sys.stderr)
         return 1
 
-    if args.require_pipeline:
-        pipes = [r for r in records if r.get('kind') == 'pipeline']
-        if not pipes:
-            print('PIPELINE GATE: no pipeline records in the stream '
-                  '(was the run started with --pipelined?)',
-                  file=sys.stderr)
+    for kind in sorted(required):
+        if not _REQUIRE_GATES[kind](records):
             return 1
-        last = pipes[-1].get('prefetch', {})
-        hits, stalls = last.get('hits', 0), last.get('stalls', 0)
-        if not hits:
-            print(f'PIPELINE GATE: 100% prefetch stalls ({stalls} stalls, '
-                  f'0 hits) — the producer never got ahead of the device',
-                  file=sys.stderr)
-            return 1
-        print(f'pipeline gate ok: {hits} hits / {stalls} stalls, '
-              f'verdict {pipes[-1].get("verdict")}', file=sys.stderr)
-
-    if args.require_comm:
-        comms = [r for r in records if r.get('kind') == 'comm']
-        if not comms:
-            print('COMM GATE: no comm records in the stream (was the run '
-                  'traced with the exchange instrumented?)',
-                  file=sys.stderr)
-            return 1
-        ex_arms = [r for r in comms if r.get('exchange')]
-        if not ex_arms:
-            print('COMM GATE: no exchange-enabled comm record — the '
-                  'sparse path was never traced', file=sys.stderr)
-            return 1
-        dirty = [r for r in ex_arms if not r.get('all_gather_free')]
-        if dirty:
-            shapes = [s for r in dirty
-                      for s in r.get('full_width_all_gathers', [])]
-            print(f'COMM GATE: {len(dirty)} exchange-enabled program(s) '
-                  f'still carry full-width all-gathers: {shapes}',
-                  file=sys.stderr)
-            return 1
-        print(f'comm gate ok: {len(comms)} comm records, '
-              f'{len(ex_arms)} exchange arms, all all-gather-free',
-              file=sys.stderr)
-
-    if args.require_tune:
-        tunes = [r for r in records if r.get('kind') == 'tune']
-        if not tunes:
-            print('TUNE GATE: no tune records in the stream (was '
-                  'scripts/tune_kernels.py run?)', file=sys.stderr)
-            return 1
-        promoted = [r for r in tunes if r.get('verdict') == 'promoted']
-        consulted = [r for r in tunes if r.get('verdict') == 'consulted']
-        if not promoted:
-            print('TUNE GATE: no candidate was promoted', file=sys.stderr)
-            return 1
-        if not consulted:
-            print('TUNE GATE: no consulted verdict — the promoted entry '
-                  'was never proven to steer a subsequent pick',
-                  file=sys.stderr)
-            return 1
-        print(f'tune gate ok: {len(tunes)} tune records, '
-              f'{len(promoted)} promoted, {len(consulted)} consulted',
-              file=sys.stderr)
 
     summary = summarize(records, anchor=args.anchor,
                         code_rev=args.code_rev)

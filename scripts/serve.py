@@ -182,6 +182,9 @@ def main(argv=None):
         batches=batcher.batches_dispatched,
         post_warmup_compiles=telemetry.post_warmup_compiles,
         compile_seconds=engine.stats()['compile_seconds'],
+        # memory-per-bucket off the warmup cost ledger (the full
+        # schema'd cost records are in the --metrics stream)
+        peak_hbm_by_bucket=engine.stats()['peak_hbm_by_bucket'],
         latency_by_bucket={
             k: {p: v[p] for p in
                 ('count', 'p50_ms', 'p95_ms', 'p99_ms', 'max_ms')}

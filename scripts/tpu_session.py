@@ -8,7 +8,9 @@ it runs the full on-chip suite in ONE process — the kernel_smoke canary,
 the flagship benchmark (the round's key deliverable, so it runs before
 the longer checks in case the tunnel dies mid-session), tpu_checks
 (equivariance at f32/bf16, fused Pallas kernel numerics + speedup),
-stage timings, baseline configs, profile — and exits cleanly so the
+baseline configs, the flagship profile with per-scope device-time
+attribution (observability.profiling), and the perf-regression gate
+(scripts/perf_gate.py vs PERF_BUDGETS.json) — and exits cleanly so the
 chip is released.
 
 Usage: python scripts/tpu_session.py [logfile]
@@ -404,11 +406,6 @@ def main():
         tpu_checks.main()
         log('tpu_checks: completed')
 
-    def stage_stage_timings():
-        import stage_timings
-        rep = stage_timings.main([])
-        log(f'stage_timings: {rep["stage_ms"]}')
-
     def stage_obs_summary():
         """Render this session's banked records into the round-close
         summary shape (observability.report): best-of-session per metric
@@ -438,6 +435,12 @@ def main():
             f'-> {out}')
 
     def stage_profile():
+        """Flagship trace + per-scope device-time attribution
+        (observability.profiling — supersedes the retired
+        stage_timings.py wall-clock stage: one traced step attributes
+        every MODEL_SCOPES region at once instead of re-jitting each
+        stage as its own upper-bound program) + the cost ledger, banked
+        as schema'd cost/profile records in PROFILE_SESSION.jsonl."""
         import numpy as np
         import jax.numpy as jnp
         from se3_transformer_tpu.training.recipes import flagship
@@ -449,13 +452,51 @@ def main():
         params = jax.jit(module.init, static_argnames=('return_type',))(
             jax.random.PRNGKey(0), feats, coors, mask=mask,
             return_type=1)['params']
-        fwd = jax.jit(lambda p, c: module.apply(
-            {'params': p}, feats, c, mask=mask, return_type=1))
-        jax.block_until_ready(fwd(params, coors))  # compile
-        from se3_transformer_tpu.utils.observability import profile_trace
-        with profile_trace('/tmp/flagship_trace'):
-            jax.block_until_ready(fwd(params, coors))
-        log('profile: /tmp/flagship_trace written')
+        compiled = jax.jit(lambda p, c: module.apply(
+            {'params': p}, feats, c, mask=mask, return_type=1)) \
+            .lower(params, coors).compile()
+        jax.block_until_ready(compiled(params, coors))  # warm dispatch
+        from se3_transformer_tpu.observability.costs import cost_payload
+        from se3_transformer_tpu.observability.profiling import (
+            capture_step_profile, profile_payload,
+        )
+        from se3_transformer_tpu.observability.report import (
+            write_record_stream,
+        )
+        hlo_text = compiled.as_text()
+        cost = cost_payload(compiled, label='flagship_fwd,n=1024,dim=64',
+                            hlo_text=hlo_text)
+        capture_step_profile(lambda: compiled(params, coors),
+                             log_dir='/tmp/flagship_trace', steps=2)
+        prof = profile_payload(
+            '/tmp/flagship_trace', label='flagship_fwd,n=1024,dim=64',
+            hlo_text=hlo_text, flops_per_step=cost['flops'], steps=2)
+        write_record_stream(
+            os.path.join(os.path.dirname(here), 'PROFILE_SESSION.jsonl'),
+            f'session_{os.getpid()}',
+            [dict(cost, kind='cost'), dict(prof, kind='profile')],
+            append=True)   # the bank is append-only like BENCH_SESSION
+        log(f'profile: /tmp/flagship_trace written; coverage '
+            f'{prof["coverage"]:.0%}, scopes '
+            f'{ {s: st["share"] for s, st in prof["scopes"].items()} }, '
+            f'peak {cost["peak_bytes"] / 2**30:.2f} GiB '
+            f'-> PROFILE_SESSION.jsonl')
+
+    def stage_perf_gate():
+        """The enforcement pass (scripts/perf_gate.py): this session's
+        banked records vs the committed PERF_BUDGETS.json. A breach
+        fails the stage — regressions exit the session non-zero instead
+        of waiting for a human to read the summary."""
+        import perf_gate
+        root = os.path.dirname(here)
+        paths = [p for p in (
+            os.path.join(root, name) for name in
+            ('BENCH_SESSION.jsonl', 'BLOCK_AB.jsonl', 'WIDTH_TABLE.jsonl',
+             'PROFILE_SESSION.jsonl')) if os.path.exists(p)]
+        rc = perf_gate.main(paths)
+        log(f'perf_gate: rc={rc}')
+        if rc:
+            raise RuntimeError(f'perf gate flagged a regression (rc={rc})')
 
     stages = [
         ('smoke', 'kernel_smoke (Mosaic lowering + numerics)',
@@ -485,11 +526,12 @@ def main():
         ('tune', 'end-to-end kernel autotune (shape-keyed table)',
          stage_kernel_tune, True),
         ('checks', 'tpu_checks', stage_tpu_checks, True),
-        ('timings', 'stage timings (flagship bench config)',
-         stage_stage_timings, True),
-        ('profile', 'flagship profile', stage_profile, False),
+        ('profile', 'flagship profile + per-scope attribution',
+         stage_profile, False),
         ('obs_summary', 'session summary (observability.report)',
          stage_obs_summary, False),
+        ('perf_gate', 'perf-regression gate (PERF_BUDGETS.json)',
+         stage_perf_gate, True),
     ]
     # SE3_TPU_SESSION_STAGES=smoke,bench,bench_fast,baselines runs a
     # focused session (e.g. an A/B after a perf commit) without redoing

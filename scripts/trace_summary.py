@@ -1,65 +1,25 @@
 """Summarize a jax.profiler trace directory: top ops by device time.
 
-Parses the Chrome-trace JSON (trace.json.gz) that jax.profiler writes
-under <dir>/plugins/profile/<ts>/ — no tensorboard/xprof needed. Events
-on device tracks (TPU/TensorCore pids) are aggregated by op name and
-printed as a table with total ms and share, so "what dominates the
-step" is one command:
+Thin CLI shim over `observability.profiling` (PR 6 consolidated the
+trace parsing there — this module's old inline parser and the retired
+`stage_timings.py` are both superseded by per-scope attribution; see
+docs/PERFORMANCE.md "Reading rooflines"). Same usage as before:
 
     python scripts/trace_summary.py --dir /tmp/flagship_trace [--top 30]
+        [--raw] [--match FILTER] [--hlo FILE]
 
-The name aggregation folds XLA's fusion suffixes (fusion.123 -> fusion)
-unless --raw; --match FILTER restricts to names containing FILTER.
+With --hlo (a compiled program's `as_text()` dump) the table also
+prints the MODEL_SCOPES attribution + coverage for the trace.
+Durations are EXCLUSIVE now (nested call/fusion events no longer
+double-count), so totals are honest where the old table inflated them.
 """
 import argparse
-import glob
-import gzip
-import json
 import os
-import re
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def find_trace_file(d):
-    pats = [os.path.join(d, 'plugins', 'profile', '*', '*.trace.json.gz'),
-            os.path.join(d, '**', '*.trace.json.gz'),
-            os.path.join(d, '*.trace.json.gz')]
-    hits = []
-    for p in pats:
-        hits += glob.glob(p, recursive=True)
-    if not hits:
-        raise FileNotFoundError(f'no *.trace.json.gz under {d}')
-    return max(hits, key=os.path.getmtime)
-
-
-def load_events(path):
-    with gzip.open(path, 'rt') as f:
-        data = json.load(f)
-    return data.get('traceEvents', [])
-
-
-def device_pids(events):
-    """pids whose process name looks like an accelerator/device track
-    (covers 'TPU', 'Tensorcore', '/device:...'; falls back to every pid
-    that is not explicitly host-side python/runtime)."""
-    names = {}
-    for ev in events:
-        if ev.get('ph') == 'M' and ev.get('name') == 'process_name':
-            names[ev['pid']] = ev.get('args', {}).get('name', '')
-    dev = {pid for pid, n in names.items()
-           if re.search(r'tpu|tensorcore|/device|gpu|accelerator', n,
-                        re.IGNORECASE)}
-    if not dev:
-        dev = {pid for pid, n in names.items()
-               if not re.search(r'python|host|plugin|runtime', n,
-                                re.IGNORECASE)}
-    return dev, names
-
-
-def fold_name(name):
-    # fusion.123 / copy.5 / custom-call.7 -> family; keep pallas kernel
-    # names (custom-call targets) intact when present in args
-    return re.sub(r'\.\d+$', '', name)
+from se3_transformer_tpu.observability import profiling  # noqa: E402
 
 
 def main(argv=None):
@@ -69,31 +29,38 @@ def main(argv=None):
     ap.add_argument('--raw', action='store_true',
                     help='no fusion-suffix folding')
     ap.add_argument('--match', default=None)
+    ap.add_argument('--hlo', default=None,
+                    help='compiled HLO text file: also attribute device '
+                         'time onto MODEL_SCOPES')
     args = ap.parse_args(argv)
 
-    path = find_trace_file(args.dir)
-    events = load_events(path)
-    dev, names = device_pids(events)
+    path = profiling.find_trace_file(args.dir)
+    events = profiling.load_trace_events(path)
+    dev, info = profiling.device_events(events)
+    # one exclusive-duration pass feeds both the op table and the
+    # scope attribution (flagship traces run to hundreds of thousands
+    # of events)
+    pairs = profiling.exclusive_durations(dev)
 
-    total = 0.0
-    agg = {}
-    for ev in events:
-        if ev.get('ph') != 'X' or ev.get('pid') not in dev:
-            continue
-        name = ev.get('name', '?')
-        if args.match and args.match not in name:
-            continue
-        dur = float(ev.get('dur', 0.0)) / 1e3  # us -> ms
-        key = name if args.raw else fold_name(name)
-        agg[key] = agg.get(key, 0.0) + dur
-        total += dur
-    rows = sorted(agg.items(), key=lambda kv: -kv[1])[:args.top]
+    rows = profiling.device_time_by_op(dev, raw=args.raw,
+                                       match=args.match, pairs=pairs)
+    total = sum(ms for _, ms in rows)
     print(f'# {path}')
-    print(f'# device tracks: '
-          f'{sorted(names.get(p, str(p)) for p in dev)}')
-    print(f'# total device-track time: {total:.1f} ms')
-    for name, ms in rows:
+    print(f'# device tracks ({info["selector"]}): {info["tracks"]}')
+    print(f'# total device time (exclusive): {total:.1f} ms')
+    for name, ms in rows[:args.top]:
         print(f'{ms:10.2f} ms  {100 * ms / total:5.1f}%  {name}')
+
+    if args.hlo:
+        with open(args.hlo) as f:
+            op_map = profiling.op_scope_map(f.read())
+        att = profiling.attribute_scopes(dev, op_map, pairs=pairs)
+        t = att['total_us'] or 1.0
+        print(f'# scope attribution (coverage '
+              f'{att["attributed_us"] / t:.0%}):')
+        for scope, us in sorted(att['scope_us'].items(),
+                                key=lambda kv: -kv[1]):
+            print(f'{us / 1e3:10.2f} ms  {100 * us / t:5.1f}%  {scope}')
     return 0
 
 

@@ -119,22 +119,28 @@ def measure_point(jax, mesh, dim, n, k, tp, execute=False):
     compiled, compile_s, args = _flagship_step(jax, mesh, dim, n, k, tp)
     rec = dict(dim=dim, n=n, k=k, compile_s=round(compile_s, 1))
     try:
-        ma = compiled.memory_analysis()
-        if isinstance(ma, (list, tuple)):
-            ma = ma[0]
-        for field in ('temp_size_in_bytes', 'argument_size_in_bytes',
-                      'output_size_in_bytes', 'alias_size_in_bytes',
-                      'generated_code_size_in_bytes'):
-            v = getattr(ma, field, None)
-            if v is not None:
-                rec[field.replace('_in_bytes', '_mb')] = round(v / 2**20, 1)
-        temp = getattr(ma, 'temp_size_in_bytes', 0) or 0
-        arg = getattr(ma, 'argument_size_in_bytes', 0) or 0
+        # the schema'd cost ledger (observability.costs): flops + the
+        # arg/output/temp split scripts/perf_gate.py budgets; the
+        # legacy row fields below derive from THE SAME ledger (one
+        # memory_analysis call, one representation — they can't drift)
+        from se3_transformer_tpu.observability.costs import cost_payload
+        rec['cost'] = cost_payload(compiled,
+                                   label=f'width,dim={dim},n={n},k={k}')
+        mem = rec['cost']['memory']
+        for name, legacy in (('temp_bytes', 'temp_size_mb'),
+                             ('argument_bytes', 'argument_size_mb'),
+                             ('output_bytes', 'output_size_mb'),
+                             ('alias_bytes', 'alias_size_mb'),
+                             ('generated_code_bytes',
+                              'generated_code_size_mb')):
+            if name in mem:
+                rec[legacy] = round(mem[name] / 2**20, 1)
         # per-shard footprint estimate: live temporaries + resident
         # arguments (params+opt state+batch shard). alias'd buffers are
         # counted inside argument size already.
-        rec['per_shard_total_gb'] = round((temp + arg) / 2**30, 3)
-    except Exception as e:  # noqa: BLE001 - memory analysis best-effort
+        rec['per_shard_total_gb'] = round(
+            (mem['temp_bytes'] + mem['argument_bytes']) / 2**30, 3)
+    except Exception as e:  # noqa: BLE001 - accounting is best-effort
         rec['memory_analysis_error'] = f'{type(e).__name__}: {e}'[:200]
     if execute:
         t0 = time.time()
@@ -217,20 +223,26 @@ def weak_scaling_point(jax, n_devices, per_device_nodes, dim, k, steps=3,
                depth=1, compile_s=round(compile_s, 1),
                host_cpus=os.cpu_count(), backend='cpu-spmd',
                overlap=overlap, exchange=exchange)
+    hlo_text = None
     try:
+        hlo_text = compiled.as_text()
         rec['comm'] = comm_payload(
-            compiled.as_text(), sp=n_devices, ring_steps=n_devices,
+            hlo_text, sp=n_devices, ring_steps=n_devices,
             overlap=overlap, exchange=exchange, full_width_dim=n)
     except Exception as e:  # noqa: BLE001 - accounting is best-effort
         rec['comm_error'] = f'{type(e).__name__}: {e}'[:200]
     try:
-        ma = compiled.memory_analysis()
-        if isinstance(ma, (list, tuple)):
-            ma = ma[0]
-        temp = getattr(ma, 'temp_size_in_bytes', 0) or 0
-        arg = getattr(ma, 'argument_size_in_bytes', 0) or 0
-        rec['per_shard_temp_mb'] = round(temp / 2**20, 1)
-        rec['per_shard_total_gb'] = round((temp + arg) / 2**30, 3)
+        # one ledger, one memory_analysis call; the legacy per-shard
+        # fields derive from it so row and cost record cannot disagree
+        from se3_transformer_tpu.observability.costs import cost_payload
+        rec['cost'] = cost_payload(
+            compiled, hlo_text=hlo_text,
+            label=f'weak_scaling,sp={n_devices},pdn={per_device_nodes},'
+                  f'overlap={overlap},exchange={exchange}')
+        mem = rec['cost']['memory']
+        rec['per_shard_temp_mb'] = round(mem['temp_bytes'] / 2**20, 1)
+        rec['per_shard_total_gb'] = round(
+            (mem['temp_bytes'] + mem['argument_bytes']) / 2**30, 3)
     except Exception as e:  # noqa: BLE001 - memory analysis best-effort
         rec['memory_analysis_error'] = f'{type(e).__name__}: {e}'[:200]
     out = compiled(params, opt_state, data, key)  # warmup
@@ -247,14 +259,19 @@ def weak_scaling_point(jax, n_devices, per_device_nodes, dim, k, steps=3,
 
 def _write_comm_stream(path, recs):
     """Schema-valid telemetry stream for the weak-scaling run: run_meta +
-    one `comm` record per measured arm (observability.schema kind='comm'
-    — `make ring-smoke` gates it via obs_report --require-comm)."""
-    from se3_transformer_tpu.observability.report import write_comm_stream
+    one `comm` AND one `cost` record per measured arm (observability
+    kinds 'comm'/'cost' — gated via obs_report --require comm,cost)."""
+    from se3_transformer_tpu.observability.report import write_record_stream
 
-    write_comm_stream(
-        path, f'weak_scaling_{os.getpid()}',
-        [dict(rec['comm'], step_s=rec.get('step_s'), label=rec.get('arm'))
-         for rec in recs if 'comm' in rec])
+    bodies = []
+    for rec in recs:
+        if 'comm' in rec:
+            bodies.append(dict(rec['comm'], kind='comm',
+                               step_s=rec.get('step_s'),
+                               label=rec.get('arm')))
+        if 'cost' in rec:
+            bodies.append(dict(rec['cost'], kind='cost'))
+    write_record_stream(path, f'weak_scaling_{os.getpid()}', bodies)
 
 
 def main(argv=None):

@@ -86,6 +86,10 @@ class InferenceEngine:
         self.timer = timer if timer is not None else PhaseTimer()
         self._executables: Dict[Tuple[int, int, str], Callable] = {}
         self.compile_seconds: Dict[Tuple[int, int, str], float] = {}
+        # per-bucket schema'd `cost` record bodies (observability.costs)
+        # — serving capacity planning reads memory-per-bucket off these;
+        # ServeTelemetry.arm() emits them into the telemetry stream
+        self.cost_payloads: Dict[Tuple[int, int, str], dict] = {}
         self.tuning_consults: list = []  # filled by warmup()
         self.batches_served: Dict[int, int] = {b: 0 for b in self.buckets}
         self.rows_served: Dict[int, int] = {b: 0 for b in self.buckets}
@@ -173,12 +177,27 @@ class InferenceEngine:
                       .compile())
         self.compile_seconds[key] = round(time.perf_counter() - t0, 3)
         self._executables[key] = executable
+        try:
+            # one cost ledger entry per bucket executable: peak HBM
+            # split + flops, the capacity-planning surface (guarded —
+            # introspection must never fail a compile that succeeded)
+            from ..observability.costs import cost_payload
+            self.cost_payloads[key] = cost_payload(
+                executable,
+                label=f'bucket_{bucket},b={self.batch_size},'
+                      f'dtype={self.dtype_name}')
+        except Exception as e:  # noqa: BLE001
+            import sys
+            print(f'engine: cost introspection failed for bucket '
+                  f'{bucket} ({type(e).__name__}: {e})', file=sys.stderr)
         return executable
 
     def warmup(self) -> Dict[Tuple[int, int, str], float]:
         """Compile every bucket; returns per-executable compile seconds.
         Call before arming a RetraceWatchdog — afterwards a healthy
-        engine produces ZERO compile events.
+        engine produces ZERO compile events. Each compile also ledgers
+        its executable into `cost_payloads` (one schema'd `cost` body
+        per bucket — ServeTelemetry.arm() streams them out).
 
         Also records which kernel block picks the AOT compiles resolved
         from the measured tuning table vs the heuristic
@@ -262,4 +281,8 @@ class InferenceEngine:
                             for b, n in self.batches_served.items() if n},
             rows_served={str(b): n
                          for b, n in self.rows_served.items() if n},
+            # memory-per-bucket off the ledger (peak = arg+out+temp,
+            # XLA's static estimate; full bodies in cost_payloads)
+            peak_hbm_by_bucket={str(k[0]): v['peak_bytes']
+                                for k, v in self.cost_payloads.items()},
             kernel_tuning=list(self.tuning_consults))

@@ -60,11 +60,20 @@ class ServeTelemetry:
         self.flush_count = 0
 
     # ------------------------------------------------------------------ #
-    def arm(self):
+    def arm(self, emit_cost_records: bool = True):
         """Baseline the compile counter after warmup: every compile event
-        from here on counts against the zero-post-warmup contract."""
+        from here on counts against the zero-post-warmup contract.
+
+        Also streams the engine's per-bucket `cost` ledger (one
+        schema'd record per warmed-up executable — peak HBM split,
+        flops, collective bytes) so serving capacity planning reads
+        memory-per-bucket off the record stream, not a debugger."""
         self.watchdog.check()        # first check arms the watchdog
         self._armed = True
+        if emit_cost_records and self.logger is not None:
+            for key in sorted(self.engine.cost_payloads):
+                self.logger.log_record('cost', mirror=False,
+                                       **self.engine.cost_payloads[key])
 
     def _drain_latencies(self):
         if self.batcher is None:

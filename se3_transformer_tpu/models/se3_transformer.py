@@ -474,36 +474,43 @@ class SE3TransformerModule(nn.Module):
         row-local (GSPMD's scatter partitioner otherwise re-materializes
         the full [b, n, n] operand per device; same rng draw, exact
         parity)."""
-        if adj_mat is not None and adj_mat.ndim == 2:
-            adj_mat = jnp.broadcast_to(adj_mat[None], (b, n, n))
-        adj_ind_full = None
-        if self.num_adj_degrees is not None:
-            assert self.num_adj_degrees >= 1, \
-                'num_adj_degrees must be at least 1'
-            adj_mat, adj_ind_full = expand_adjacency(adj_mat,
-                                                     self.num_adj_degrees)
-        num_sparse = 0
-        sp_full = None
-        if self.attend_sparse_neighbors:
-            num_sparse = int(min(self.max_sparse_neighbors, n - 1))
-            noise_key = self.make_rng('neighbor_noise') \
-                if self.has_rng('neighbor_noise') else jax.random.PRNGKey(0)
-            noise_n1 = jax.random.uniform(
-                noise_key, (b, n, n - 1), minval=-0.01, maxval=0.01)
-            if bonded_fn is not None:
-                sp_full = bonded_fn(adj_mat, noise_n1, num_sparse)
-            else:
-                self_excl = exclude_self_indices(n)
-                noise_full = jnp.zeros((b, n, n), noise_n1.dtype).at[
-                    :, jnp.arange(n)[:, None], self_excl].set(noise_n1)
-                adj_noself = adj_mat.astype(bool) \
-                    & ~jnp.eye(n, dtype=bool)[None]
-                # the diagonal carries value 0 (+0 noise) and the >0.5
-                # bonded threshold filters it, so the full-layout
-                # selection equals remove_self of the dense one exactly
-                sp_full = sparse_neighbor_mask(adj_noself, num_sparse,
-                                               noise_full)
-        return adj_mat, adj_ind_full, sp_full, num_sparse
+        # 'adjacency' scope (observability.timing.MODEL_SCOPES): the
+        # jittered scatter + top-k below lowers to whiles that dominate
+        # toy CPU traces — without the label, profile attribution
+        # (`make profile-smoke`) loses half its device time
+        with named_scope('adjacency'):
+            if adj_mat is not None and adj_mat.ndim == 2:
+                adj_mat = jnp.broadcast_to(adj_mat[None], (b, n, n))
+            adj_ind_full = None
+            if self.num_adj_degrees is not None:
+                assert self.num_adj_degrees >= 1, \
+                    'num_adj_degrees must be at least 1'
+                adj_mat, adj_ind_full = expand_adjacency(
+                    adj_mat, self.num_adj_degrees)
+            num_sparse = 0
+            sp_full = None
+            if self.attend_sparse_neighbors:
+                num_sparse = int(min(self.max_sparse_neighbors, n - 1))
+                noise_key = self.make_rng('neighbor_noise') \
+                    if self.has_rng('neighbor_noise') \
+                    else jax.random.PRNGKey(0)
+                noise_n1 = jax.random.uniform(
+                    noise_key, (b, n, n - 1), minval=-0.01, maxval=0.01)
+                if bonded_fn is not None:
+                    sp_full = bonded_fn(adj_mat, noise_n1, num_sparse)
+                else:
+                    self_excl = exclude_self_indices(n)
+                    noise_full = jnp.zeros((b, n, n), noise_n1.dtype).at[
+                        :, jnp.arange(n)[:, None], self_excl].set(noise_n1)
+                    adj_noself = adj_mat.astype(bool) \
+                        & ~jnp.eye(n, dtype=bool)[None]
+                    # the diagonal carries value 0 (+0 noise) and the
+                    # >0.5 bonded threshold filters it, so the
+                    # full-layout selection equals remove_self of the
+                    # dense one exactly
+                    sp_full = sparse_neighbor_mask(adj_noself, num_sparse,
+                                                   noise_full)
+            return adj_mat, adj_ind_full, sp_full, num_sparse
 
     def _body(self, feats, hood, edges, mask, global_feats, return_type,
               return_pooled, num_degrees, fiber_in, fiber_hidden, fiber_out,

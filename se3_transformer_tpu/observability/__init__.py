@@ -19,6 +19,20 @@ a re-export shim). Four pillars:
     window selection, outlier flagging, vs_baseline. CLI:
     `scripts/obs_report.py`.
 
+Two attribution pillars joined in PR 6:
+
+  * `costs`    — HLO cost ledger: any lowered/AOT executable ->
+    schema'd `cost` record (flops/bytes via `cost_analysis()` with an
+    HLO-parse fallback, peak HBM split argument/output/temp, per-class
+    collective bytes). Consumed by bench.py, the training step
+    factories, `InferenceEngine.warmup` (one record per shape bucket),
+    and scripts/width_table.py; enforced by scripts/perf_gate.py.
+  * `profiling` — per-scope device-time attribution: jax.profiler
+    traces parsed (no tensorboard) onto the `MODEL_SCOPES` labels via
+    the compiled HLO's op_name metadata -> schema'd `profile` record
+    with coverage + roofline utilization. Supersedes the ad-hoc
+    trace_summary/stage_timings script pair.
+
 `schema` holds the record contract both producers and the validator
 share (`make obs-smoke` gates on it).
 """
@@ -37,4 +51,10 @@ from .schema import (  # noqa: F401
 from .report import (  # noqa: F401
     load_jsonl, summarize_bench_records, summarize_telemetry,
     summarize_tune_records,
+)
+from .costs import (  # noqa: F401
+    cost_payload, step_cost_payload,
+)
+from .profiling import (  # noqa: F401
+    capture_step_profile, profile_payload,
 )

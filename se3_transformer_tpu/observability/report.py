@@ -114,7 +114,7 @@ def summarize_telemetry(records: List[dict],
         if rid not in runs:
             runs[rid] = dict(meta=None, flushes=[], summary=None,
                              retrace_warnings=0, steps=[], pipeline=None,
-                             tune=[], comm=[])
+                             tune=[], comm=[], cost=[], profile=[])
             order.append(rid)
         kind = rec.get('kind')
         if kind == 'run_meta':
@@ -136,6 +136,12 @@ def summarize_telemetry(records: List[dict],
             # one per traced program; an A/B run carries several (the
             # overlapped and serialized arms), all surfaced
             runs[rid]['comm'].append(rec)
+        elif kind == 'cost':
+            # one per compiled program (a bucketed engine carries one
+            # per shape bucket), all surfaced
+            runs[rid]['cost'].append(rec)
+        elif kind == 'profile':
+            runs[rid]['profile'].append(rec)
 
     out = []
     for rid in order:
@@ -191,6 +197,10 @@ def summarize_telemetry(records: List[dict],
             rec['kernel_tuning'] = summarize_tune_records(run['tune'])
         if run['comm']:
             rec['comm'] = summarize_comm_records(run['comm'])
+        if run['cost']:
+            rec['cost'] = summarize_cost_records(run['cost'])
+        if run['profile']:
+            rec['profile'] = summarize_profile_records(run['profile'])
         out.append(rec)
     return out
 
@@ -217,35 +227,53 @@ def summarize_tune_records(records: List[dict]) -> dict:
                 promoted=promoted, consulted=consulted)
 
 
+def write_record_stream(path: str, run_id: str,
+                        records: List[dict],
+                        append: bool = False) -> List[dict]:
+    """Schema-valid JSONL telemetry stream: one run_meta header + the
+    given records (each a dict WITH its `kind`; run_id is stamped in).
+    Every record is validated before anything is written — ring_smoke,
+    `width_table --weak-scaling`, `make profile-smoke`, and the
+    tpu_session profile stage all route their streams through here, so
+    a schema change breaks loudly in exactly one place.
+
+    The header's backend/device metadata comes from the live process
+    (metrics.collect_run_meta — callers have an initialized backend by
+    the time they hold records to write), so an on-chip session's
+    banked cost/profile evidence is never mislabeled as CPU. This lazy
+    import is the one jax touch in this module; the read/summarize
+    paths stay backend-free for `obs_report` on a wedged tunnel."""
+    import os
+
+    from .metrics import collect_run_meta
+    from .schema import validate_record
+
+    meta = collect_run_meta()
+    meta.update(run_id=run_id,
+                code_rev=meta.get('code_rev')
+                or os.environ.get('SE3_TPU_CODE_REV', 'dev'),
+                backend=meta.get('backend') or 'cpu')
+    out = [meta]
+    out += [dict(rec, run_id=run_id) for rec in records]
+    for r in out:
+        validate_record(r)
+    # append=True is for long-lived banks (PROFILE_SESSION.jsonl):
+    # each run adds its own run_meta + records, so cross-session
+    # trajectories survive and perf_gate's latest-record-wins model
+    # holds; per-run /tmp streams keep the default truncate
+    with open(path, 'a' if append else 'w') as f:
+        for r in out:
+            f.write(json.dumps(r) + '\n')
+    return out
+
+
 def write_comm_stream(path: str, run_id: str,
                       comm_bodies: List[dict]) -> List[dict]:
-    """Schema-valid JSONL telemetry stream for a comm-accounting run:
-    one run_meta header + one kind='comm' record per body (each a
-    `parallel.exchange.comm_payload` dict, optionally already carrying
-    label/step_s). Every record is validated before anything is
-    written — `make ring-smoke` and `width_table --weak-scaling` both
-    route their streams through here, so a schema change breaks loudly
-    in exactly one place."""
-    import os
-    import platform
-    import socket
-
-    from .schema import SCHEMA_VERSION, validate_record
-
-    records = [dict(kind='run_meta', run_id=run_id,
-                    schema_version=SCHEMA_VERSION, backend='cpu',
-                    code_rev=os.environ.get('SE3_TPU_CODE_REV', 'dev'),
-                    host=dict(hostname=socket.gethostname(),
-                              pid=os.getpid(),
-                              python=platform.python_version()))]
-    records += [dict(kind='comm', run_id=run_id, **body)
-                for body in comm_bodies]
-    for r in records:
-        validate_record(r)
-    with open(path, 'w') as f:
-        for r in records:
-            f.write(json.dumps(r) + '\n')
-    return records
+    """write_record_stream for a comm-accounting run: one kind='comm'
+    record per body (each a `parallel.exchange.comm_payload` dict,
+    optionally already carrying label/step_s)."""
+    return write_record_stream(
+        path, run_id, [dict(kind='comm', **body) for body in comm_bodies])
 
 
 def summarize_comm_records(records: List[dict]) -> dict:
@@ -273,6 +301,46 @@ def summarize_comm_records(records: List[dict]) -> dict:
         all_gather_free=bool(exchange_arms) and all(
             a.get('all_gather_free') for a in exchange_arms),
     )
+
+
+def summarize_cost_records(records: List[dict]) -> dict:
+    """Reduce cost records (observability.costs.cost_payload rows) to
+    the view the run report surfaces: one row per program label with
+    flops/peak memory and the source that produced them (a fallback
+    estimate stays distinguishable from XLA's analysis)."""
+    costs = [r for r in records if r.get('kind', 'cost') == 'cost']
+    programs = []
+    for r in costs:
+        row = {k: r[k] for k in ('label', 'source', 'flops',
+                                 'bytes_accessed') if k in r}
+        mem = r.get('memory') or {}
+        row['peak_bytes'] = r.get('peak_bytes')
+        row['peak_gb'] = round((r.get('peak_bytes') or 0) / 2**30, 3)
+        row['temp_bytes'] = mem.get('temp_bytes')
+        if r.get('collectives'):
+            row['collectives'] = r['collectives']
+        programs.append(row)
+    return dict(programs=len(programs), by_program=programs)
+
+
+def summarize_profile_records(records: List[dict]) -> dict:
+    """Reduce profile records (observability.profiling.profile_payload
+    rows) to the surfaced view: per-program coverage, device time, and
+    the hottest scopes."""
+    profs = [r for r in records if r.get('kind', 'profile') == 'profile']
+    programs = []
+    for r in profs:
+        row = {k: r[k] for k in ('label', 'device_time_ms', 'coverage',
+                                 'steps') if k in r}
+        scopes = r.get('scopes') or {}
+        row['scopes'] = {
+            s: st.get('share') for s, st in
+            sorted(scopes.items(),
+                   key=lambda kv: -(kv[1].get('time_ms') or 0))}
+        if r.get('roofline'):
+            row['roofline'] = r['roofline']
+        programs.append(row)
+    return dict(programs=len(programs), by_program=programs)
 
 
 def summarize(records: List[dict], anchor: Optional[float] = None,

@@ -48,6 +48,23 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    a violation is diagnosable from the record alone) +
                    all_gather_free (bool — `make ring-smoke` gates on
                    it for the sp>1 exchange arm).
+  cost             HLO cost ledger for one compiled program
+                   (observability.costs.cost_payload): label, flops /
+                   bytes_accessed with the load-bearing `source` field
+                   (cost_analysis / hlo_estimate / unavailable — a
+                   fallback estimate must never masquerade as XLA's
+                   analysis), memory split {argument_bytes,
+                   output_bytes, temp_bytes, ...}, peak_bytes (the
+                   static argument+output+temp estimate), and the
+                   per-class collective {count, bytes} ledger reused
+                   from parallel.exchange.analyze_hlo_comm.
+  profile          per-scope device-time attribution of one captured
+                   trace (observability.profiling.profile_payload):
+                   label, scopes (per-MODEL_SCOPES-label {time_ms,
+                   share}), device_time_ms, and the load-bearing
+                   coverage field (share of device time attributed to
+                   known scopes — `make profile-smoke` gates on it);
+                   optional roofline utilization vs the bf16 MXU peak.
   summary          end-of-run cumulative record (metrics, timing,
                    nodes_steps_per_sec, loss trajectory,
                    retrace_warnings_total).
@@ -64,7 +81,7 @@ from typing import Iterable, Union
 SCHEMA_VERSION = 1
 
 KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
-               'serve', 'tune', 'comm', 'summary')
+               'serve', 'tune', 'comm', 'cost', 'profile', 'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
@@ -89,6 +106,15 @@ _REQUIRED = {
     # traced program re-materialized a full-width operand proves nothing
     'comm': ('run_id', 'sp', 'ring_steps', 'overlap', 'exchange',
              'collectives', 'full_width_all_gathers', 'all_gather_free'),
+    # source is the load-bearing field of the cost ledger: a record
+    # that cannot say whether its numbers came from XLA's analysis or
+    # a parsed-HLO estimate proves nothing about either
+    'cost': ('run_id', 'label', 'source', 'flops', 'bytes_accessed',
+             'memory', 'peak_bytes', 'collectives'),
+    # coverage is the load-bearing field of the attribution contract:
+    # a profile record that cannot say how much device time its scopes
+    # account for proves nothing about where the time went
+    'profile': ('run_id', 'label', 'scopes', 'device_time_ms', 'coverage'),
     'summary': ('run_id', 'steps', 'metrics', 'timing'),
 }
 
@@ -97,6 +123,10 @@ _TUNE_VERDICTS = ('admitted', 'promoted', 'rejected', 'consulted',
 
 _PIPELINE_PREFETCH_REQUIRED = ('depth', 'hits', 'stalls')
 _PIPELINE_VERDICTS = ('producer_bound', 'device_bound', 'balanced')
+
+_COST_SOURCES = ('cost_analysis', 'hlo_estimate', 'unavailable')
+_COST_MEMORY_REQUIRED = ('argument_bytes', 'output_bytes', 'temp_bytes')
+_PROFILE_SCOPE_REQUIRED = ('time_ms', 'share')
 
 _TIMING_REQUIRED = ('count', 'p50_ms', 'p95_ms', 'max_ms')
 # serving SLOs are quoted at p99 — a serve record without it is invalid
@@ -198,6 +228,59 @@ def validate_record(rec: dict, index=None) -> dict:
         if rec['all_gather_free'] and rec['full_width_all_gathers']:
             _fail(index, 'comm.all_gather_free=true contradicts a '
                          'non-empty full_width_all_gathers list')
+    if kind == 'cost':
+        if rec['source'] not in _COST_SOURCES:
+            _fail(index, f'cost.source {rec["source"]!r} not in '
+                         f'{_COST_SOURCES}')
+        mem = rec['memory']
+        missing = [k for k in _COST_MEMORY_REQUIRED
+                   if not isinstance(mem, dict) or k not in mem]
+        if missing:
+            _fail(index, f'cost.memory missing {missing} (the '
+                         f'argument/output/temp split IS the ledger)')
+        for k in _COST_MEMORY_REQUIRED:
+            if not isinstance(mem[k], (int, float)) or mem[k] < 0:
+                _fail(index, f'cost.memory[{k!r}] must be a '
+                             f'non-negative number, got {mem[k]!r}')
+        if not isinstance(rec['peak_bytes'], (int, float)) \
+                or rec['peak_bytes'] < 0:
+            _fail(index, f'cost.peak_bytes must be a non-negative '
+                         f'number, got {rec["peak_bytes"]!r}')
+        if rec['source'] == 'cost_analysis' and (
+                not isinstance(rec['flops'], (int, float))
+                or rec['flops'] < 0):
+            _fail(index, f'cost.flops must be a non-negative number '
+                         f'when source=cost_analysis, got '
+                         f'{rec["flops"]!r}')
+        colls = rec['collectives']
+        if not isinstance(colls, dict):
+            _fail(index, 'cost.collectives must be an object')
+        for cls, st in colls.items():
+            missing = [k for k in ('count', 'bytes')
+                       if not isinstance(st, dict) or k not in st]
+            if missing:
+                _fail(index, f'cost.collectives[{cls!r}] missing '
+                             f'{missing}')
+    if kind == 'profile':
+        scopes = rec['scopes']
+        if not isinstance(scopes, dict):
+            _fail(index, 'profile.scopes must be an object')
+        for scope, st in scopes.items():
+            missing = [k for k in _PROFILE_SCOPE_REQUIRED
+                       if not isinstance(st, dict) or k not in st]
+            if missing:
+                _fail(index, f'profile.scopes[{scope!r}] missing '
+                             f'{missing} (per-scope time+share are the '
+                             f'whole attribution)')
+        cov = rec['coverage']
+        if not isinstance(cov, (int, float)) or not 0 <= cov <= 1:
+            _fail(index, f'profile.coverage must be a number in [0, 1], '
+                         f'got {cov!r}')
+        if not isinstance(rec['device_time_ms'], (int, float)) \
+                or rec['device_time_ms'] < 0:
+            _fail(index, f'profile.device_time_ms must be a '
+                         f'non-negative number, got '
+                         f'{rec["device_time_ms"]!r}')
     if kind in ('flush', 'summary'):
         timing = rec['timing']
         if not isinstance(timing, dict):

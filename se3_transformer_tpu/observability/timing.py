@@ -26,6 +26,9 @@ import jax
 # (scripts/profile_model.py docstring mirrors this list)
 MODEL_SCOPES = (
     'neighbors',          # models/se3_transformer.py — kNN selection
+    'adjacency',          # models/se3_transformer.py — adjacency
+    #                       expansion + jittered bonded top-k (the
+    #                       scatter whiles; dominant on toy CPU traces)
     'basis',              # models/se3_transformer.py — SH basis
     'conv_in',            # models/se3_transformer.py
     'trunk',              # models/se3_transformer.py

@@ -85,6 +85,13 @@ class DenoiseConfig:
     # feeds the same device batch twice must leave this off (see the
     # donation audit in parallel.sharding.make_sharded_train_step)
     donate_batch: bool = False
+    # emit one schema'd `cost` record for the compiled train step
+    # (observability.costs) after the first step of train()/
+    # train_pipelined(). Opt-in: the ledger lowers+compiles the step a
+    # second time — warm under the persistent compilation cache and
+    # seconds on toy configs, but a flagship program over a TPU tunnel
+    # should opt in deliberately
+    cost_record: bool = False
 
     def build_module(self) -> SE3TransformerModule:
         return SE3TransformerModule(
@@ -315,6 +322,56 @@ class DenoiseTrainer:
         return {k: np.stack([b[k] for b in batches]) for k in batches[0]}
 
     # ------------------------------------------------------------------ #
+    # cost ledger (observability.costs): the step factories' compiled
+    # program -> one schema'd `cost` record
+    # ------------------------------------------------------------------ #
+    def cost_record(self, batch, metric_logger=None) -> dict:
+        """Ledger the CURRENT train step executable against `batch`
+        (same placement rules as train_step): flops, bytes accessed,
+        peak memory split argument/output/temp, collective bytes.
+        Emits a `cost` record through `metric_logger` when given;
+        returns the record fields either way. Lower+compile only — the
+        copy never executes, so donation marks are harmless — and warm
+        whenever the step already compiled under the persistent
+        compilation cache."""
+        assert self.params is not None, 'cost_record requires an ' \
+            'initialized trainer (run a step or call init first)'
+        from ..observability.costs import step_cost_payload
+        if self.mesh is not None:
+            batch = shard_batch(batch, self.mesh,
+                                leading_axes=1 if self.cfg.accum_steps > 1
+                                else 0)
+        rng = jax.random.PRNGKey(self.cfg.seed)
+        args = (self.params, self.opt_state, batch, rng)
+        if self.cfg.telemetry:
+            args = args + (self.metric_acc,)
+        fields = step_cost_payload(self._step_fn, *args,
+                                   label=self._telemetry_label())
+        if metric_logger is not None:
+            return metric_logger.log_record('cost', mirror=False, **fields)
+        fields['kind'] = 'cost'
+        return fields
+
+    def _maybe_cost_record(self, batch, metric_logger, history):
+        """First-step ledger hook, shared by train/train_pipelined and
+        denoise.py's dataset loop. Call it BEFORE the first step: with
+        donate_batch on, the step deletes the batch buffers, and
+        lower() only reads shapes. Lazily inits exactly like
+        train_step (accum batches carry a leading micro axis)."""
+        if not self.cfg.cost_record:
+            return
+        try:
+            if self.params is None:
+                self.init(jax.tree_util.tree_map(lambda v: v[0], batch)
+                          if self.cfg.accum_steps > 1 else batch)
+            history.append(self.cost_record(batch, metric_logger))
+        except Exception as e:  # noqa: BLE001 - the ledger must never
+            # cost the training run
+            import warnings
+            warnings.warn(f'cost record failed ({type(e).__name__}: {e})',
+                          stacklevel=2)
+
+    # ------------------------------------------------------------------ #
     # telemetry (observability package): flush cadence owned by the host
     # ------------------------------------------------------------------ #
     def _telemetry_label(self) -> str:
@@ -419,6 +476,8 @@ class DenoiseTrainer:
                     batch = self.micro_batches()
             else:
                 batch = self.micro_batches()
+            if i == 0:
+                self._maybe_cost_record(batch, metric_logger, history)
             loss = self.train_step(batch)
             if (checkpoint_manager is not None and checkpoint_every > 0
                     and self.step_count % checkpoint_every == 0):
@@ -513,6 +572,8 @@ class DenoiseTrainer:
                 producer, depth=cfg.prefetch_depth, sharding=place,
                 phase_timer=self.phase_timer, stats=stats)
             for i, batch in enumerate(itertools.islice(batches, num_steps)):
+                if i == 0:
+                    self._maybe_cost_record(batch, metric_logger, history)
                 loss = self.train_step(batch, preplaced=True)
                 if (checkpoint_manager is not None and checkpoint_every > 0
                         and self.step_count % checkpoint_every == 0):

@@ -1,0 +1,495 @@
+"""Cost/profile attribution layer (PR 6): schema negative cases for the
+new `cost`/`profile` record kinds, the cost ledger on a real compiled
+CPU program plus the fallback path when `cost_analysis()` returns None,
+trace parsing + per-scope attribution on a synthetic Chrome trace (no
+profiler dependency — the parser's contract is the trace FORMAT), the
+unified `obs_report --require` flag, and the perf gate's pass /
+breach / injected-regression behavior on synthetic budgets."""
+import gzip
+import json
+import os
+import sys
+
+import pytest
+
+from se3_transformer_tpu.observability import profiling
+from se3_transformer_tpu.observability.costs import (
+    cost_payload, hlo_dot_flops,
+)
+from se3_transformer_tpu.observability.report import write_record_stream
+from se3_transformer_tpu.observability.schema import (
+    SchemaError, validate_record,
+)
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), 'scripts')
+
+
+def _cost_body(**over):
+    body = dict(kind='cost', run_id='r', label='t', source='cost_analysis',
+                flops=1.0, bytes_accessed=2.0,
+                memory=dict(argument_bytes=1, output_bytes=2, temp_bytes=3),
+                peak_bytes=6,
+                collectives={'all-reduce': dict(count=1, bytes=4)})
+    body.update(over)
+    return body
+
+
+def _profile_body(**over):
+    body = dict(kind='profile', run_id='r', label='t',
+                scopes=dict(trunk=dict(time_ms=1.0, share=0.5)),
+                device_time_ms=2.0, coverage=0.5)
+    body.update(over)
+    return body
+
+
+# --------------------------------------------------------------------- #
+# schema: negative cases
+# --------------------------------------------------------------------- #
+def test_cost_profile_records_validate():
+    validate_record(_cost_body())
+    validate_record(_profile_body())
+
+
+@pytest.mark.parametrize('mutation, fragment', [
+    (dict(source='guess'), 'source'),
+    (dict(memory=dict(argument_bytes=1, output_bytes=2)), 'temp_bytes'),
+    (dict(memory=dict(argument_bytes=-1, output_bytes=2, temp_bytes=3)),
+     'non-negative'),
+    (dict(peak_bytes=-5), 'peak_bytes'),
+    (dict(flops=None), 'flops'),           # required numeric under
+    #                                        source=cost_analysis
+    (dict(collectives={'all-gather': dict(count=1)}), 'bytes'),
+    (dict(collectives='lots'), 'object'),
+])
+def test_cost_schema_negative(mutation, fragment):
+    with pytest.raises(SchemaError, match=fragment):
+        validate_record(_cost_body(**mutation))
+
+
+def test_cost_flops_may_be_null_for_fallback_sources():
+    validate_record(_cost_body(source='hlo_estimate', flops=None))
+    validate_record(_cost_body(source='unavailable', flops=None))
+
+
+@pytest.mark.parametrize('mutation, fragment', [
+    (dict(coverage=1.5), 'coverage'),
+    (dict(coverage='high'), 'coverage'),
+    (dict(scopes=dict(trunk=dict(time_ms=1.0))), 'share'),
+    (dict(scopes=['trunk']), 'object'),
+    (dict(device_time_ms=-1.0), 'device_time_ms'),
+])
+def test_profile_schema_negative(mutation, fragment):
+    with pytest.raises(SchemaError, match=fragment):
+        validate_record(_profile_body(**mutation))
+
+
+def test_required_fields_missing():
+    for kind, body in (('cost', _cost_body()), ('profile', _profile_body())):
+        for field in ('label', 'run_id'):
+            bad = dict(body)
+            del bad[field]
+            with pytest.raises(SchemaError, match='missing'):
+                validate_record(bad)
+
+
+# --------------------------------------------------------------------- #
+# cost ledger on a real compiled program + the None-cost_analysis
+# fallback (the CPU-backend fallback satellite)
+# --------------------------------------------------------------------- #
+_HLO_DOT = '''
+ENTRY %main {
+  %dot.1 = f32[8,16]{1,0} dot(f32[8,32]{1,0} %a, f32[32,16]{1,0} %b), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %dot.2 = f32[8,8]{1,0} dot(f32[8,16]{1,0} %dot.1, f32[8,16]{1,0} %c), lhs_contracting_dims={1}, rhs_contracting_dims={1}
+}
+'''
+
+
+def test_hlo_dot_flops_counts_contractions():
+    # 2*8*16*32 + 2*8*8*16 = 8192 + 2048
+    assert hlo_dot_flops(_HLO_DOT) == 10240.0
+
+
+@pytest.fixture(scope='module')
+def tiny_compiled():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x, y):
+        return jnp.tanh(x @ y).sum(-1)
+
+    x = jnp.ones((32, 16))
+    return jax.jit(f).lower(x, x.T).compile()
+
+
+def test_cost_payload_real_backend(tiny_compiled):
+    body = cost_payload(tiny_compiled, label='tiny')
+    validate_record(dict(kind='cost', run_id='r', **body))
+    assert body['source'] == 'cost_analysis'
+    assert body['flops'] > 0
+    assert body['peak_bytes'] > 0
+    mem = body['memory']
+    assert body['peak_bytes'] == (mem['argument_bytes']
+                                  + mem['output_bytes'] + mem['temp_bytes'])
+
+
+class _NullCostExecutable:
+    """A backend whose cost_analysis returns None (some plugin backends
+    do) but which still exposes HLO text and memory analysis."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def cost_analysis(self):
+        return None
+
+    def memory_analysis(self):
+        return self._inner.memory_analysis()
+
+    def as_text(self):
+        return self._inner.as_text()
+
+
+def test_cost_payload_falls_back_to_hlo_estimate(tiny_compiled):
+    body = cost_payload(_NullCostExecutable(tiny_compiled), label='fb')
+    validate_record(dict(kind='cost', run_id='r', **body))
+    assert body['source'] == 'hlo_estimate'
+    # the dot is 2*32*32*16; elementwise tanh/sum are deliberately
+    # uncounted by the fallback
+    assert body['flops'] == pytest.approx(2 * 32 * 32 * 16)
+    assert body['bytes_accessed'] is None
+    assert body['peak_bytes'] > 0
+
+
+class _DeadCostExecutable:
+    """memory_analysis works; cost_analysis AND HLO text do not —
+    the source='unavailable' path with honest memory numbers."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def cost_analysis(self):
+        raise RuntimeError('backend exposes nothing')
+
+    def memory_analysis(self):
+        return self._inner.memory_analysis()
+
+    def as_text(self):
+        raise RuntimeError('no HLO either')
+
+
+def test_cost_payload_unavailable_source_keeps_real_memory(tiny_compiled):
+    body = cost_payload(_DeadCostExecutable(tiny_compiled), label='dead')
+    validate_record(dict(kind='cost', run_id='r', **body))
+    assert body['source'] == 'unavailable'
+    assert body['flops'] is None
+    assert body['peak_bytes'] > 0
+
+
+def test_cost_payload_refuses_zero_memory_fabrication(tiny_compiled):
+    """A backend without memory_analysis must raise, never emit a
+    peak_bytes=0 record that passes every memory ceiling vacuously."""
+
+    class _NoMemory:
+        def cost_analysis(self):
+            return tiny_compiled.cost_analysis()
+
+        def memory_analysis(self):
+            return None
+
+        def as_text(self):
+            return ''
+
+    with pytest.raises(RuntimeError, match='memory_analysis'):
+        cost_payload(_NoMemory(), label='nomem')
+
+
+# --------------------------------------------------------------------- #
+# trace parsing + attribution on a synthetic Chrome trace
+# --------------------------------------------------------------------- #
+def _write_trace(d, events):
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, 'host.trace.json.gz')
+    with gzip.open(path, 'wt') as f:
+        json.dump(dict(traceEvents=events), f)
+    return path
+
+
+def _x(name, ts, dur, pid=7, tid=1, hlo=True):
+    args = {'hlo_op': name, 'hlo_module': 'jit_f'} if hlo else {}
+    return dict(ph='X', pid=pid, tid=tid, ts=ts, dur=dur, name=name,
+                args=args)
+
+
+_SYNTH_HLO = '''
+%dot.3 = f32[4,4]{1,0} dot(...), metadata={op_name="jit(f)/jit(main)/trunk/matmul"}
+%exp_fusion.clone = f32[4]{0} fusion(...), metadata={op_name="jit(f)/jit(main)/transpose(jvp(attention))/exp"}
+%call.2 = f32[4]{0} call(...), metadata={op_name="jit(f)/jit(main)"}
+'''
+
+
+def test_exclusive_durations_subtract_nested_children():
+    events = [
+        _x('call.2', 0, 100),          # wraps the fusion: 40 exclusive
+        _x('exp_fusion.clone', 10, 60),
+        _x('dot.3', 200, 50),
+    ]
+    excl = {ev['name']: us
+            for ev, us in profiling.exclusive_durations(events)}
+    assert excl == {'call.2': 40.0, 'exp_fusion.clone': 60.0, 'dot.3': 50.0}
+
+
+def test_scope_attribution_and_payload(tmp_path):
+    events = [
+        dict(ph='M', pid=7, name='process_name',
+             args=dict(name='/host:CPU')),
+        _x('call.2', 0, 100),
+        _x('exp_fusion.clone', 10, 60),   # attention (via transpose(jvp))
+        _x('dot.3', 200, 50),             # trunk
+        _x('mystery.9', 300, 30),         # unattributed
+    ]
+    d = str(tmp_path / 'trace')
+    _write_trace(d, events)
+
+    dev, info = profiling.device_events(profiling.load_trace_events(d))
+    assert info['selector'] == 'hlo_op' and len(dev) == 4
+
+    op_map = profiling.op_scope_map(_SYNTH_HLO)
+    assert op_map['dot.3'] == 'trunk'
+    assert op_map['exp_fusion.clone'] == 'attention'
+    assert 'call.2' not in op_map    # no scope component on its path
+
+    body = profiling.profile_payload(d, label='synthetic',
+                                     hlo_text=_SYNTH_HLO,
+                                     flops_per_step=1e6, steps=2)
+    validate_record(dict(kind='profile', run_id='r', **body))
+    # exclusive device time: 40 (call) + 60 + 50 + 30 = 180 us;
+    # attributed: 60 (attention) + 50 (trunk)
+    assert body['device_time_ms'] == pytest.approx(0.18)
+    assert body['coverage'] == pytest.approx(110 / 180, abs=1e-3)
+    assert body['scopes']['attention']['time_ms'] == pytest.approx(0.06)
+    assert body['scopes']['trunk']['share'] == pytest.approx(50 / 180,
+                                                             abs=1e-3)
+    assert body['unattributed_top'][0]['op'] in ('call', 'mystery')
+    assert body['roofline']['device_flops_per_sec'] == pytest.approx(
+        2e6 / 180e-6)
+
+
+def test_innermost_scope_wins_and_pallas_not_swallowed():
+    by_len = sorted(profiling.MODEL_SCOPES, key=len, reverse=True)
+    assert profiling._scope_of_path(
+        'jit(f)/trunk/attention/mul', profiling.MODEL_SCOPES,
+        by_len) == 'attention'
+    assert profiling._scope_of_path(
+        'jit(f)/trunk/pallas_attention/kernel', profiling.MODEL_SCOPES,
+        by_len) == 'pallas_attention'
+
+
+# --------------------------------------------------------------------- #
+# obs_report: unified --require flag + aliases
+# --------------------------------------------------------------------- #
+@pytest.fixture(scope='module')
+def scripts_path():
+    mp = pytest.MonkeyPatch()
+    mp.syspath_prepend(SCRIPTS)
+    yield
+    mp.undo()
+
+
+def _stream(path, bodies):
+    write_record_stream(str(path), 'testrun', bodies)
+    return str(path)
+
+
+def test_obs_report_require_cost_profile(tmp_path, scripts_path, capsys):
+    import obs_report
+    good = _stream(tmp_path / 'good.jsonl',
+                   [{k: v for k, v in _cost_body().items()
+                     if k != 'run_id'},
+                    {k: v for k, v in _profile_body().items()
+                     if k != 'run_id'}])
+    assert obs_report.main([good, '--validate',
+                            '--require', 'cost,profile']) == 0
+    # a zero-peak ledger fails the cost gate
+    empty = _stream(tmp_path / 'empty.jsonl',
+                    [{k: v for k, v in
+                      _cost_body(peak_bytes=0).items() if k != 'run_id'}])
+    assert obs_report.main([empty, '--require', 'cost']) == 1
+    # profile gate needs a profile record
+    assert obs_report.main([good, '--require', 'tune']) == 1
+    assert obs_report.main([good, '--require', 'nonsense']) == 2
+    capsys.readouterr()
+
+
+def test_obs_report_legacy_flags_alias_require(tmp_path, scripts_path,
+                                               capsys):
+    import obs_report
+    comm = _stream(tmp_path / 'comm.jsonl', [dict(
+        kind='comm', sp=2, ring_steps=2, overlap=True, exchange=True,
+        collectives={}, full_width_all_gathers=[], all_gather_free=True)])
+    assert obs_report.main([comm, '--require-comm']) == 0
+    assert obs_report.main([comm, '--require', 'comm']) == 0
+    capsys.readouterr()
+
+
+# --------------------------------------------------------------------- #
+# perf gate: pass, breach, injection, missing semantics
+# --------------------------------------------------------------------- #
+@pytest.fixture()
+def gate(tmp_path, scripts_path):
+    import perf_gate
+
+    budgets = dict(version=1, default_margin=0.1, budgets=[
+        dict(name='tput_floor', kind='bench',
+             match={'metric': 'toy'}, field='value', min=100.0),
+        dict(name='mem_ceiling', kind='cost',
+             match={'label': 'toy'}, field='peak_bytes',
+             max=1000, margin=0.2),
+        dict(name='ag_free', kind='comm', match={'exchange': True},
+             field='all_gather_free', equals=True, axis='sp'),
+        dict(name='absent_coll', kind='comm', match={'exchange': True},
+             field='collectives.all-gather.bytes', max=10,
+             missing='zero'),
+    ])
+    bpath = tmp_path / 'budgets.json'
+    bpath.write_text(json.dumps(budgets))
+
+    def run(records, extra=()):
+        rpath = tmp_path / 'records.jsonl'
+        with open(rpath, 'w') as f:
+            for r in records:
+                f.write(json.dumps(r) + '\n')
+        return perf_gate.main([str(rpath), '--budgets', str(bpath),
+                               *extra])
+
+    return run
+
+
+GOOD = [
+    dict(metric='toy(run)', value=150.0, unit='u'),
+    dict(kind='cost', label='toy', peak_bytes=900),
+    dict(kind='comm', exchange=True, all_gather_free=True,
+         collectives={}),
+]
+
+
+def test_perf_gate_passes_within_margins(gate, capsys):
+    assert gate(GOOD) == 0
+    out = capsys.readouterr().out
+    assert out.count('[ ok ]') == 4 and 'REGRESSION' not in out
+
+
+def test_perf_gate_fails_on_breach_and_names_it(gate, capsys):
+    bad = GOOD + [dict(kind='cost', label='toy', peak_bytes=5000)]
+    assert gate(bad) == 1
+    out = capsys.readouterr().out
+    assert '[FAIL] mem_ceiling' in out and 'ceiling 1200' in out
+
+
+def test_perf_gate_latest_record_wins(gate, capsys):
+    # an old breach followed by a healthy record passes: streams are
+    # chronological and the gate judges the latest evidence
+    healed = [dict(kind='cost', label='toy', peak_bytes=5000)] + GOOD
+    assert gate(healed) == 0
+    capsys.readouterr()
+
+
+def test_perf_gate_margin_is_applied(gate, capsys):
+    # min 100 at margin 10% -> floor 90
+    edge = [dict(GOOD[0], value=91.0)] + GOOD[1:]
+    assert gate(edge) == 0
+    below = [dict(GOOD[0], value=89.0)] + GOOD[1:]
+    assert gate(below) == 1
+    capsys.readouterr()
+
+
+def test_perf_gate_injection_fires_every_budget(gate, capsys):
+    assert gate(GOOD, extra=('--inject-regression',)) == 1
+    capsys.readouterr()
+
+
+def test_perf_gate_skip_vs_strict(gate, capsys):
+    only_bench = [GOOD[0]]
+    assert gate(only_bench) == 0                       # others skip
+    assert gate(only_bench, extra=('--strict',)) == 1  # skips fail
+    out = capsys.readouterr().out
+    assert '[SKIP]' in out
+
+
+def test_perf_gate_equals_and_missing_zero(gate, capsys):
+    dirty = GOOD[:2] + [dict(kind='comm', exchange=True,
+                             all_gather_free=False, collectives={})]
+    assert gate(dirty) == 1
+    out = capsys.readouterr().out
+    assert '[FAIL] ag_free' in out and '[axis=sp]' in out
+    # absent collective class counts as 0 bytes under missing: zero
+    assert '[ ok ] absent_coll' in out
+
+
+def test_perf_gate_group_by_judges_every_axis_point(tmp_path,
+                                                    scripts_path, capsys):
+    """A clean final sweep point must not mask a regression at an
+    earlier axis value: group_by judges the latest record PER sp."""
+    import perf_gate
+    budgets = dict(version=1, budgets=[dict(
+        name='ag_free_all_sp', kind='comm', match={'exchange': True},
+        field='all_gather_free', equals=True, axis='sp',
+        group_by='sp')])
+    bpath = tmp_path / 'b.json'
+    bpath.write_text(json.dumps(budgets))
+
+    def run(records):
+        rpath = tmp_path / 'r.jsonl'
+        with open(rpath, 'w') as f:
+            for r in records:
+                f.write(json.dumps(r) + '\n')
+        return perf_gate.main([str(rpath), '--budgets', str(bpath)])
+
+    def comm(sp, clean):
+        return dict(kind='comm', exchange=True, sp=sp,
+                    all_gather_free=clean, collectives={})
+
+    # sp=2 latest record dirty, sp=8 clean and LAST in the stream
+    assert run([comm(2, True), comm(2, False), comm(8, True)]) == 1
+    out = capsys.readouterr().out
+    assert 'sp-groups breach' in out
+    # a healed sp=2 row later in the stream clears its group
+    assert run([comm(2, False), comm(2, True), comm(8, True)]) == 0
+    capsys.readouterr()
+
+
+def test_perf_gate_committed_budgets_are_loadable(scripts_path):
+    # the committed PERF_BUDGETS.json must stay structurally valid:
+    # every budget names a kind, a field, and exactly one constraint
+    root = os.path.dirname(SCRIPTS)
+    with open(os.path.join(root, 'PERF_BUDGETS.json')) as f:
+        spec = json.load(f)
+    assert spec['budgets'], 'no budgets committed'
+    for b in spec['budgets']:
+        assert b.get('name') and b.get('kind') and b.get('field')
+        assert sum(k in b for k in ('min', 'max', 'equals')) == 1
+
+
+# --------------------------------------------------------------------- #
+# trainer cost record (the training-step-factory wiring)
+# --------------------------------------------------------------------- #
+@pytest.mark.heavy
+def test_trainer_cost_record_schema_and_peak(tmp_path):
+    from se3_transformer_tpu.observability import MetricLogger
+    from se3_transformer_tpu.observability.schema import validate_stream
+    from se3_transformer_tpu.training.denoise import (
+        DenoiseConfig, DenoiseTrainer, synthetic_protein_batch,
+    )
+    cfg = DenoiseConfig(num_nodes=24, accum_steps=1, num_degrees=2)
+    trainer = DenoiseTrainer(cfg)
+    batch = synthetic_protein_batch(cfg, trainer.np_rng)
+    trainer.init(batch)
+    path = str(tmp_path / 'cost.jsonl')
+    with MetricLogger(path, mirror=None) as logger:
+        rec = trainer.cost_record(batch, metric_logger=logger)
+    assert rec['kind'] == 'cost'
+    assert rec['peak_bytes'] > 0
+    assert rec['memory']['temp_bytes'] > 0
+    assert rec['label'].startswith('denoise,')
+    info = validate_stream(path)
+    assert info['kinds']['cost'] == 1

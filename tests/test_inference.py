@@ -266,6 +266,32 @@ def test_queue_depth_sheds_load():
 # --------------------------------------------------------------------- #
 # serve record schema
 # --------------------------------------------------------------------- #
+def test_warmup_ledgers_cost_per_bucket(engine, tmp_path):
+    """PR 6 acceptance: every warmed-up bucket carries a schema-valid
+    `cost` record body with nonzero peak memory, and ServeTelemetry.arm
+    streams them out so capacity planning reads memory-per-bucket off
+    the record stream."""
+    from se3_transformer_tpu.observability import MetricLogger
+    from se3_transformer_tpu.observability.schema import validate_stream
+
+    assert set(engine.cost_payloads) == set(engine.executables)
+    for key, body in engine.cost_payloads.items():
+        validate_record(dict(kind='cost', run_id='r', **body))
+        assert body['peak_bytes'] > 0
+        assert body['memory']['temp_bytes'] >= 0
+        assert f'bucket_{key[0]}' in body['label']
+    stats = engine.stats()
+    assert set(stats['peak_hbm_by_bucket']) == {str(b) for b in BUCKETS}
+    assert all(v > 0 for v in stats['peak_hbm_by_bucket'].values())
+
+    path = str(tmp_path / 'serve_costs.jsonl')
+    with MetricLogger(path, mirror=None) as logger:
+        tele = ServeTelemetry(engine, logger=logger)
+        tele.arm()
+    info = validate_stream(path)
+    assert info['kinds']['cost'] == len(BUCKETS)
+
+
 def test_serve_record_schema_requires_p99():
     good = dict(kind='serve', run_id='r',
                 requests=dict(served=3, rejected=dict(oversize=1)),

@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke perf-gate clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke perf-gate clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -32,6 +32,12 @@ obs-smoke:         ## 3-step CPU denoise with telemetry: schema-gates the JSONL,
 serve-smoke:       ## 3-request CPU serving run (2 buckets + 1 oversize reject): exits non-zero unless the telemetry stream is schema-valid AND zero post-warmup compiles fired
 	rm -f /tmp/serve_smoke.jsonl
 	python scripts/serve.py --requests 3 --oversize 1 --buckets 12,24 --batch-size 2 --cpu --metrics /tmp/serve_smoke.jsonl --out /tmp/serve_smoke_summary.json
+
+serve-multi-smoke: ## 2-replica CPU continuous-batching gate: >=1 admission into an in-flight bucket slot, one mid-run rolling weight swap with zero dropped requests and zero post-warmup compiles, schema-valid stream (--require serve), and the serve perf budgets
+	rm -f /tmp/serve_multi_smoke.jsonl
+	python scripts/serve.py --replicas 2 --requests 16 --oversize 1 --swap-at 8 --buckets 12,24 --batch-size 2 --max-wait-ms 50 --cpu --metrics /tmp/serve_multi_smoke.jsonl --out /tmp/serve_multi_smoke_summary.json
+	python scripts/obs_report.py /tmp/serve_multi_smoke.jsonl --validate --require serve --out /tmp/serve_multi_report.json
+	python scripts/perf_gate.py /tmp/serve_multi_smoke.jsonl
 
 pipeline-smoke:    ## 6-step pipelined CPU denoise (docs/PERFORMANCE.md): exits non-zero on schema violation or a 100% prefetch-stall rate
 	rm -f /tmp/pipeline_smoke.jsonl

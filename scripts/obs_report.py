@@ -6,7 +6,7 @@ Usage:
         [--code-rev REV] [--require kind[,kind...]]
 
 --require gates the stream on record kinds (pipeline / comm / tune /
-cost / profile), each with its load-bearing check; the old
+cost / profile / serve), each with its load-bearing check; the old
 --require-pipeline/--require-comm/--require-tune flags are aliases.
 
 Input species are auto-detected per record:
@@ -154,9 +154,51 @@ def _gate_profile(records):
     return True
 
 
+def _gate_serve(records):
+    serves = [r for r in records if r.get('kind') == 'serve']
+    if not serves:
+        print('SERVE GATE: no serve records in the stream (was the run '
+              'served through ServeTelemetry/RouterTelemetry?)',
+              file=sys.stderr)
+        return False
+    # counters are cumulative, so the last record carries the verdict
+    last = serves[-1]
+    answered = (last.get('requests') or {}).get('served') or 0
+    if not answered:
+        print('SERVE GATE: zero answered requests in the final serve '
+              'record — the stream proves nothing was served',
+              file=sys.stderr)
+        return False
+    timed = [(b, st) for r in serves
+             for b, st in (r.get('buckets') or {}).items()]
+    if not timed:
+        print('SERVE GATE: no per-bucket latency section in any serve '
+              'record — the SLO surface is empty', file=sys.stderr)
+        return False
+    broken = [b for b, st in timed
+              if not isinstance(st, dict)
+              or any(st.get(k) is None
+                     for k in ('count', 'p50_ms', 'p95_ms', 'p99_ms'))]
+    if broken:
+        print(f'SERVE GATE: bucket(s) {sorted(set(broken))} missing or '
+              f'null latency percentiles (count/p50/p95/p99 are the '
+              f'SLO surface)', file=sys.stderr)
+        return False
+    extras = ''
+    if 'continuous_admissions' in last:
+        extras = (f", {last['continuous_admissions']} continuous "
+                  f"admissions, {len(last.get('replicas') or {})} "
+                  f"replicas, {(last.get('swaps') or {}).get('count', 0)} "
+                  f"swap events")
+    print(f'serve gate ok: {len(serves)} serve records, {answered} '
+          f'answered rows, {len(timed)} timed bucket windows{extras}',
+          file=sys.stderr)
+    return True
+
+
 _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       tune=_gate_tune, cost=_gate_cost,
-                      profile=_gate_profile)
+                      profile=_gate_profile, serve=_gate_serve)
 
 
 def main(argv=None):
@@ -180,7 +222,9 @@ def main(argv=None):
                          'free; tune: a promotion that is consulted; '
                          'cost: every program ledgers nonzero peak '
                          'memory; profile: per-scope attribution '
-                         'present with its coverage figure) and exits '
+                         'present with its coverage figure; serve: '
+                         'per-bucket latency percentiles present and '
+                         'a nonzero answered count) and exits '
                          'non-zero on failure')
     # legacy aliases for the unified --require flag (kept: Makefiles and
     # session scripts in the wild still pass them)

@@ -5,6 +5,7 @@ Usage:
         [--buckets 12,24] [--batch-size 2] [--max-wait-ms 5]
         [--max-queue-depth 64] [--bf16] [--checkpoint DIR] [--cpu]
         [--metrics SERVE.jsonl] [--out SUMMARY.json] [--seed S]
+        [--replicas N] [--swap-at K]
 
 Startup: restore params (params-only — optimizer state never
 materializes) or init a toy model, AOT-compile one executable per
@@ -17,6 +18,19 @@ This doubles as the `make serve-smoke` gate, exiting non-zero when
   * any post-warmup compile event fired (the AOT contract: a
     mixed-length stream over precompiled buckets must compile NOTHING),
   * or an in-range request failed to produce a result.
+
+`--replicas N` (N > 1) switches to the multi-replica continuous-
+batching router (se3_transformer_tpu.serving): N replica workers, each
+owning its own AOT engine, least-outstanding dispatch, requests
+admitted into in-flight bucket slots (deadline only as a fallback),
+and — with `--swap-at K` — one rolling weight swap after the K-th
+request (fresh seeded params; zero recompiles, zero dropped requests).
+This is the `make serve-multi-smoke` gate; on top of the single-replica
+gates it also exits non-zero when
+  * no request was ever admitted into an in-flight slot
+    (continuous_admissions == 0 — the router degenerated to flush
+    barriers), or
+  * the rolling swap did not complete across every replica.
 """
 import argparse
 import json
@@ -58,7 +72,60 @@ def parse_args(argv=None):
     ap.add_argument('--seed', type=int, default=0)
     ap.add_argument('--cpu', action='store_true',
                     help='force the CPU backend')
+    ap.add_argument('--replicas', type=int, default=1,
+                    help='>1 routes through the multi-replica '
+                         'continuous-batching router '
+                         '(se3_transformer_tpu.serving)')
+    ap.add_argument('--swap-at', type=int, default=None,
+                    help='multi-replica only: after this many submitted '
+                         'requests, hot-swap fresh weights with a '
+                         'rolling drain (zero recompiles, zero drops)')
     return ap.parse_args(argv)
+
+
+def build_module_and_params(args, buckets, seed=None):
+    """Toy module + params (checkpoint restore or seeded init) — shared
+    by the single-replica and router paths."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from se3_transformer_tpu.native.loader import chain_adjacency
+    from se3_transformer_tpu.training.denoise import DenoiseConfig
+
+    seed = args.seed if seed is None else seed
+    cfg = DenoiseConfig(num_tokens=24, dim=8, dim_head=8, heads=2, depth=2,
+                        num_degrees=2, max_sparse_neighbors=4)
+    module = cfg.build_module()
+    rng = np.random.RandomState(seed)
+    if args.checkpoint:
+        from se3_transformer_tpu.training.checkpoint import CheckpointManager
+        params = CheckpointManager(args.checkpoint).restore_params()
+        print(f'restored params-only from {args.checkpoint}')
+    else:
+        L = buckets[0]
+        params = module.init(
+            jax.random.PRNGKey(seed),
+            jnp.asarray(rng.randint(0, cfg.num_tokens, size=(1, L))),
+            jnp.asarray(rng.normal(size=(1, L, 3)).astype(np.float32)),
+            mask=jnp.ones((1, L), bool),
+            adj_mat=jnp.asarray(chain_adjacency(L)),
+            return_type=1)['params']
+        print(f'no --checkpoint: initialized fresh params (seed {seed})')
+    return cfg, module, params
+
+
+def request_lengths(args, buckets, max_len, rng):
+    """Mixed-length stream: in-range lengths cycling across buckets,
+    plus the oversize (must-reject) tail, shuffled."""
+    lows = [1] + [b + 1 for b in buckets[:-1]]
+    lengths = [int(rng.randint(lows[i % len(buckets)],
+                               buckets[i % len(buckets)] + 1))
+               for i in range(args.requests)]
+    lengths += [max_len + int(rng.randint(1, 32))
+                for _ in range(args.oversize)]
+    rng.shuffle(lengths)
+    return lengths
 
 
 def main(argv=None):
@@ -67,40 +134,22 @@ def main(argv=None):
     if args.cpu:
         jax.config.update('jax_platforms', 'cpu')
     enable_compilation_cache()
+    if args.replicas > 1:
+        return serve_multi(args)
     import numpy as np
 
     from se3_transformer_tpu.inference import (
         AdmissionController, InferenceEngine, MicroBatcher,
         RequestRejected, ServeTelemetry,
     )
-    from se3_transformer_tpu.native.loader import chain_adjacency
     from se3_transformer_tpu.observability import MetricLogger
     from se3_transformer_tpu.observability.schema import (
         SchemaError, validate_stream,
     )
-    from se3_transformer_tpu.training.denoise import DenoiseConfig
     import jax.numpy as jnp
 
     buckets = tuple(int(b) for b in args.buckets.split(','))
-    cfg = DenoiseConfig(num_tokens=24, dim=8, dim_head=8, heads=2, depth=2,
-                        num_degrees=2, max_sparse_neighbors=4)
-    module = cfg.build_module()
-
-    rng = np.random.RandomState(args.seed)
-    if args.checkpoint:
-        from se3_transformer_tpu.training.checkpoint import CheckpointManager
-        params = CheckpointManager(args.checkpoint).restore_params()
-        print(f'restored params-only from {args.checkpoint}')
-    else:
-        L = buckets[0]
-        params = module.init(
-            jax.random.PRNGKey(args.seed),
-            jnp.asarray(rng.randint(0, cfg.num_tokens, size=(1, L))),
-            jnp.asarray(rng.normal(size=(1, L, 3)).astype(np.float32)),
-            mask=jnp.ones((1, L), bool),
-            adj_mat=jnp.asarray(chain_adjacency(L)),
-            return_type=1)['params']
-        print('no --checkpoint: initialized fresh (seeded) params')
+    cfg, module, params = build_module_and_params(args, buckets)
 
     # ---- startup: AOT-compile every bucket, then arm the watchdog ---- #
     t0 = time.perf_counter()
@@ -125,13 +174,8 @@ def main(argv=None):
     telemetry.arm()
 
     # ---- the request stream: lengths cycle across buckets ----------- #
-    lows = [1] + [b + 1 for b in engine.buckets[:-1]]
-    lengths = [int(rng.randint(lows[i % len(buckets)],
-                               engine.buckets[i % len(buckets)] + 1))
-               for i in range(args.requests)]
-    lengths += [engine.max_len + int(rng.randint(1, 32))
-                for _ in range(args.oversize)]
-    rng.shuffle(lengths)
+    rng = np.random.RandomState(args.seed)
+    lengths = request_lengths(args, engine.buckets, engine.max_len, rng)
 
     pending, flushed_at = [], 0
     for length in lengths:
@@ -192,6 +236,156 @@ def main(argv=None):
             if k.startswith('bucket_')},
         request_latency_ms=summary['metrics']['request_latency_ms'],
         batch_fill=summary['metrics'].get('batch_fill'),
+    )
+    print(json.dumps(report, indent=2))
+    if args.out:
+        with open(args.out, 'w') as f:
+            json.dump(report, f, indent=2)
+        print(f'report -> {args.out}')
+    return 0 if ok else 1
+
+
+def serve_multi(args):
+    """Multi-replica continuous-batching path (`--replicas N`)."""
+    import numpy as np
+
+    from se3_transformer_tpu.inference import (
+        AdmissionController, InferenceEngine, RequestRejected,
+    )
+    from se3_transformer_tpu.observability import MetricLogger, PhaseTimer
+    from se3_transformer_tpu.observability.schema import (
+        SchemaError, validate_stream,
+    )
+    from se3_transformer_tpu.serving import (
+        ReplicaWorker, Router, RouterTelemetry,
+    )
+    import jax.numpy as jnp
+
+    buckets = tuple(int(b) for b in args.buckets.split(','))
+    cfg, module, params = build_module_and_params(args, buckets)
+
+    # ---- startup: N replicas, ONE shared PhaseTimer (the aggregate
+    # per-bucket SLO surface), every bucket AOT-compiled per replica --- #
+    t0 = time.perf_counter()
+    timer = PhaseTimer()
+    engines = [InferenceEngine(
+        module, params, buckets=buckets, batch_size=args.batch_size,
+        return_type=1, timer=timer,
+        activation_dtype=jnp.bfloat16 if args.bf16 else None)
+        for _ in range(args.replicas)]
+    print(f'warmup: {args.replicas} replicas x '
+          f'{len(engines[0].executables)} bucket executables in '
+          f'{time.perf_counter() - t0:.1f}s')
+
+    workers = [ReplicaWorker(i, e, max_wait_ms=args.max_wait_ms)
+               for i, e in enumerate(engines)]
+    admission = AdmissionController(max_len=buckets[-1],
+                                    max_queue_depth=args.max_queue_depth)
+    router = Router(workers, admission=admission)
+
+    # materialize the swap weights BEFORE arming the compile watchdog:
+    # a real rolling reload restores numpy leaves off the async-
+    # checkpoint path (zero compiles); the smoke's stand-in — a fresh
+    # seeded init — compiles eager init programs, which must land in
+    # the warmup window, not against the AOT contract
+    swap_params = None
+    if args.swap_at is not None:
+        _, _, swap_params = build_module_and_params(
+            args, buckets, seed=args.seed + 1)
+    logger = MetricLogger(args.metrics, run_meta=dict(
+        mode='serve_multi', replicas=args.replicas,
+        buckets=list(buckets), batch_size=args.batch_size,
+        dtype=engines[0].dtype_name))
+    telemetry = RouterTelemetry(router, admission, logger)
+    telemetry.arm()
+
+    # ---- the request stream, with one mid-run rolling weight swap --- #
+    rng = np.random.RandomState(args.seed)
+    lengths = request_lengths(args, buckets, router.max_len, rng)
+
+    pending, flushed_at, swapped = [], 0, False
+    for i, length in enumerate(lengths):
+        if args.swap_at is not None and i == args.swap_at and not swapped:
+            # same shapes, new values: the swap must compile NOTHING
+            # and drop NOTHING (the gates below prove both)
+            events = router.swap_weights(swap_params,
+                                         tag=f'seed_{args.seed + 1}')
+            swapped = True
+            print(f'rolling weight swap after request {i}: '
+                  f'{len(events)} replicas swapped, '
+                  f'{sum(e["drained_batches"] for e in events)} partial '
+                  f'batches drained')
+        tokens = rng.randint(0, cfg.num_tokens, size=length)
+        coords = rng.normal(size=(length, 3)).astype(np.float32)
+        try:
+            pending.append(router.submit(tokens, coords))
+        except RequestRejected as e:
+            print(f'rejected: {e.code} {e.detail}')
+            logger.log_record('step', mirror=False, step=len(pending),
+                              rejected=e.to_record())
+        router.pump()
+        if router.batches_dispatched - flushed_at >= args.flush_every:
+            telemetry.flush()
+            flushed_at = router.batches_dispatched
+    # deadline-drain the stragglers, then close the stream
+    while router.queue_depth:
+        wait = router.next_deadline()
+        if wait:
+            time.sleep(wait)
+        router.pump()
+    telemetry.flush()
+    summary = telemetry.close()
+    logger.close()
+
+    # ---- gates + report --------------------------------------------- #
+    ok = True
+    unanswered = [p.request_id for p in pending if not p.ok]
+    if unanswered:
+        print(f'FAIL: {len(unanswered)} admitted requests unanswered '
+              f'(the rolling swap must drop NOTHING)')
+        ok = False
+    if telemetry.post_warmup_compiles:
+        print(f'FAIL: {telemetry.post_warmup_compiles} compile events '
+              f'after warmup — a weight swap or mixed-length stream '
+              f'broke the AOT contract')
+        ok = False
+    if not router.continuous_admissions:
+        print('FAIL: zero continuous admissions — no request ever '
+              'joined an in-flight bucket slot, the router degenerated '
+              'to flush barriers')
+        ok = False
+    if args.swap_at is not None and \
+            len(router.swap_events) != args.replicas:
+        print(f'FAIL: rolling swap incomplete: '
+              f'{len(router.swap_events)} swap events for '
+              f'{args.replicas} replicas')
+        ok = False
+    if args.metrics:
+        try:
+            info = validate_stream(args.metrics)
+            print(f'schema ok: {info["records"]} records {info["kinds"]}')
+        except SchemaError as e:
+            print(f'FAIL: telemetry stream invalid: {e}')
+            ok = False
+
+    report = dict(
+        ok=ok,
+        replicas=args.replicas,
+        requests=dict(total=len(lengths), answered=len(pending) -
+                      len(unanswered), **admission.snapshot()),
+        batches=router.batches_dispatched,
+        continuous_admissions=router.continuous_admissions,
+        deadline_flushes=router.deadline_flushes,
+        swaps=dict(count=len(router.swap_events),
+                   events=router.swap_events),
+        post_warmup_compiles=telemetry.post_warmup_compiles,
+        per_replica={str(w.id): w.snapshot() for w in router.workers},
+        latency_by_bucket={
+            k: {p: v[p] for p in
+                ('count', 'p50_ms', 'p95_ms', 'p99_ms', 'max_ms')}
+            for k, v in summary['timing'].items()
+            if k.startswith('bucket_')},
+        request_latency_ms=summary['metrics']['request_latency_ms'],
     )
     print(json.dumps(report, indent=2))
     if args.out:

@@ -63,6 +63,44 @@ class PendingResult:
         return self.completed_at - self.submitted_at
 
 
+def dispatch_batch(runner, bucket: int, batch_size: int, tokens, coords,
+                   pending: List[PendingResult],
+                   completed: List[PendingResult],
+                   completed_capacity: int,
+                   clock: Callable[[], float]) -> None:
+    """THE dispatch body — pad, run, resolve — shared by `MicroBatcher`
+    (deadline micro-batching) and `serving.ContinuousBatcher`
+    (in-flight slots), so the pad/slice/error contract cannot drift
+    between them. Pads with `native.loader.pad_to_bucket` (the training
+    dataset's padder), slices each result back to its request's true
+    rows, and on a raising runner resolves EVERY request of the batch
+    done-with-error (no submitter hangs forever) before re-raising."""
+    tokens, coords, mask = pad_to_bucket(tokens, coords, bucket,
+                                         batch_size=batch_size)
+    try:
+        out = np.asarray(runner(bucket, tokens, coords, mask))
+    except Exception as e:
+        now = clock()
+        for p in pending:
+            p.error = e
+            p.done = True
+            p.completed_at = now
+            completed.append(p)
+        if len(completed) > completed_capacity:
+            del completed[:-completed_capacity]
+        raise
+    now = clock()
+    for row, p in enumerate(pending):
+        # copy: a view would pin the whole [B, L, ...] batch output
+        # alive for as long as any single request's result is held
+        p.result = np.array(out[row, :p.length])
+        p.done = True
+        p.completed_at = now
+        completed.append(p)
+    if len(completed) > completed_capacity:
+        del completed[:-completed_capacity]
+
+
 class _BucketQueue:
     __slots__ = ('bucket', 'tokens', 'coords', 'pending')
 
@@ -195,38 +233,15 @@ class MicroBatcher:
 
     # ------------------------------------------------------------------ #
     def _flush(self, q: _BucketQueue):
-        tokens, coords, mask = pad_to_bucket(
-            q.tokens, q.coords, q.bucket, batch_size=self.batch_size)
-        pending = q.pending
+        # the queue is cleared BEFORE dispatch: on a raising runner the
+        # requests resolve done-with-error (never silently requeued)
+        tokens, coords, pending = q.tokens, q.coords, q.pending
         q.tokens, q.coords, q.pending = [], [], []
-        try:
-            out = np.asarray(self.runner(q.bucket, tokens, coords, mask))
-        except Exception as e:
-            # the queue is already cleared: resolve EVERY request in the
-            # batch with the error (done=True, ok=False) so no submitter
-            # is left holding a result that can never arrive, then
-            # re-raise for the serve loop's own handling
-            now = self.clock()
-            for p in pending:
-                p.error = e
-                p.done = True
-                p.completed_at = now
-                self.completed.append(p)
-            if len(self.completed) > self._completed_capacity:
-                del self.completed[:-self._completed_capacity]
-            raise
-        now = self.clock()
+        dispatch_batch(self.runner, q.bucket, self.batch_size, tokens,
+                       coords, pending, self.completed,
+                       self._completed_capacity, self.clock)
         self.batches_dispatched += 1
         self.rows_dispatched += len(pending)
         agg_update(self.fill_stats, [len(pending)])
         if len(self.fill_history) < self._fill_capacity:
             self.fill_history.append(len(pending))
-        for row, p in enumerate(pending):
-            # copy: a view would pin the whole [B, L, ...] batch output
-            # alive for as long as any single request's result is held
-            p.result = np.array(out[row, :p.length])
-            p.done = True
-            p.completed_at = now
-            self.completed.append(p)
-        if len(self.completed) > self._completed_capacity:
-            del self.completed[:-self._completed_capacity]

@@ -24,6 +24,17 @@ Params stay a call argument (not baked), so a checkpoint refresh is
 `engine.params = mgr.restore_params()` — no recompile as long as shapes
 match. The persistent compilation cache (`utils.compilation_cache`)
 makes even the startup compiles warm across process restarts.
+
+Sharded serving (ROADMAP item 3): pass `mesh` (+ optionally
+`partition_rules`, a `parallel.rules` rule set name or rule list —
+default 'tp') and the engine becomes mesh-aware end to end: params are
+restored/placed directly into their `NamedSharding`s via the partition-
+rule engine (the SAME rules training's `shard_params` uses — serving
+and training shardings cannot drift), every bucket executable is
+AOT-compiled against the SHARDED abstract params (so one large model
+spans chips while DP replicas multiply throughput), and request arrays
+are committed replicated onto the mesh at `run()`. The params-only
+orbax restore path and the per-bucket cost ledger are unchanged.
 """
 from __future__ import annotations
 
@@ -33,6 +44,7 @@ from typing import Callable, Dict, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..native.loader import chain_adjacency, pad_to_bucket
 from ..observability import PhaseTimer
@@ -68,8 +80,16 @@ class InferenceEngine:
                  donate_buffers: Optional[bool] = None,
                  apply_kwargs: Optional[dict] = None,
                  timer: Optional[PhaseTimer] = None,
+                 mesh: Optional[Mesh] = None,
+                 partition_rules=None,
                  precompile: bool = True):
         self.module = module
+        self.mesh = mesh
+        # rule set name ('replicated'/'tp'/'fsdp') or explicit rule
+        # list (parallel.rules); only consulted when a mesh is given
+        self.partition_rules = ('tp' if partition_rules is None
+                                else partition_rules)
+        self.param_specs = None      # filled by the params setter
         self.params = params         # property setter device_puts once
         self.buckets = tuple(sorted(int(b) for b in buckets))
         assert self.buckets, 'no buckets'
@@ -118,8 +138,18 @@ class InferenceEngine:
         # leaves, and re-transferring the whole parameter set host-to-
         # device on every run() call would dominate per-batch latency
         # off-CPU. A setter so the checkpoint-refresh recipe
-        # `engine.params = mgr.restore_params()` stays fast too.
-        self._params = jax.device_put(value)
+        # `engine.params = mgr.restore_params()` stays fast too. With a
+        # mesh, every leaf goes straight into the NamedSharding its
+        # partition rule names (host arrays shard on the way in — the
+        # full tensor is never replicated across the mesh first), and a
+        # weight swap re-places into the SAME specs so the AOT
+        # executables keep matching without a recompile.
+        if self.mesh is None:
+            self._params = jax.device_put(value)
+            return
+        from ..parallel.rules import place_with_rules
+        self._params, self.param_specs = place_with_rules(
+            value, self.mesh, self.partition_rules)
 
     @property
     def dtype_name(self) -> str:
@@ -153,11 +183,38 @@ class InferenceEngine:
 
         return fn
 
+    @property
+    def _replicated(self) -> Optional[NamedSharding]:
+        return (NamedSharding(self.mesh, P())
+                if self.mesh is not None else None)
+
     def _abstract_batch(self, bucket: int):
         B, L = self.batch_size, bucket
-        sds = jax.ShapeDtypeStruct
+        repl = self._replicated
+
+        def sds(shape, dtype):
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=repl)
+
         return (sds((B, L), jnp.int32), sds((B, L, 3), jnp.float32),
                 sds((B, L), jnp.bool_))
+
+    def _abstract_params(self):
+        """ShapeDtypeStructs of the placed params; on a mesh they carry
+        the rule engine's NamedShardings, so the AOT compile partitions
+        the whole program around sharded weights."""
+        mesh = self.mesh
+
+        def abstract(a, spec=None):
+            sharding = (NamedSharding(mesh, spec)
+                        if mesh is not None else None)
+            return jax.ShapeDtypeStruct(
+                np.shape(a), getattr(a, 'dtype', np.dtype(type(a))),
+                sharding=sharding)
+
+        if mesh is None:
+            return jax.tree_util.tree_map(abstract, self.params)
+        return jax.tree_util.tree_map(abstract, self.params,
+                                      self.param_specs)
 
     def compile_bucket(self, bucket: int) -> Callable:
         """AOT lower+compile one bucket's executable (idempotent)."""
@@ -165,10 +222,7 @@ class InferenceEngine:
         if key in self._executables:
             return self._executables[key]
         assert bucket in self.buckets, f'{bucket} is not a configured bucket'
-        abstract_params = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(
-                np.shape(a), getattr(a, 'dtype', np.dtype(type(a)))),
-            self.params)
+        abstract_params = self._abstract_params()
         tokens, coords, mask = self._abstract_batch(bucket)
         donate = (2,) if self.donate_buffers else ()  # coords buffer
         t0 = time.perf_counter()
@@ -246,10 +300,18 @@ class InferenceEngine:
         executable = self._executables.get(self._key(bucket))
         if executable is None:
             executable = self.compile_bucket(bucket)
+        tokens = jnp.asarray(tokens, jnp.int32)
+        coords = jnp.asarray(coords, jnp.float32)
+        mask = jnp.asarray(mask, jnp.bool_)
+        if self.mesh is not None:
+            # AOT executables are strict about input placement: commit
+            # the request arrays replicated onto the mesh (the compiled
+            # program was lowered with exactly these shardings)
+            repl = self._replicated
+            tokens, coords, mask = (jax.device_put(x, repl)
+                                    for x in (tokens, coords, mask))
         with self.timer.phase(bucket_phase(bucket)):
-            out = executable(self.params, jnp.asarray(tokens, jnp.int32),
-                             jnp.asarray(coords, jnp.float32),
-                             jnp.asarray(mask, jnp.bool_))
+            out = executable(self.params, tokens, coords, mask)
             out = jax.block_until_ready(out)
         self.batches_served[bucket] += 1
         self.rows_served[bucket] += int(np.asarray(mask).any(-1).sum())
@@ -271,9 +333,23 @@ class InferenceEngine:
     # ------------------------------------------------------------------ #
     def stats(self) -> dict:
         """Engine-side counters for the serve telemetry record."""
+        sharding = None
+        if self.mesh is not None:
+            n_sharded = sum(
+                1 for s in jax.tree_util.tree_leaves(
+                    self.param_specs,
+                    is_leaf=lambda x: isinstance(x, P))
+                if any(a is not None for a in s))
+            sharding = dict(
+                mesh={a: int(s) for a, s in
+                      zip(self.mesh.axis_names, self.mesh.devices.shape)},
+                rules=(self.partition_rules
+                       if isinstance(self.partition_rules, str)
+                       else 'custom'),
+                sharded_params=n_sharded)
         return dict(
             buckets=list(self.buckets), batch_size=self.batch_size,
-            dtype=self.dtype_name,
+            dtype=self.dtype_name, sharding=sharding,
             executables=[list(k) for k in self._executables],
             compile_seconds={str(k[0]): v
                              for k, v in self.compile_seconds.items()},

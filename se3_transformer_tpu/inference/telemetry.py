@@ -14,21 +14,107 @@ Composes the observability primitives rather than inventing new ones:
   * request latencies (queue wait + execute, from `MicroBatcher`'s
     completed results) and batch fill fold into window-shaped metrics
     for the end-of-run `summary` record.
+
+`ServeTelemetryBase` is the shared record-assembly plumbing: the
+single-engine `ServeTelemetry` here and the multi-replica
+`serving.RouterTelemetry` both build their `serve` records from the
+same helpers, so the record shape cannot drift between the one-replica
+and N-replica paths.
 """
 from __future__ import annotations
 
 from typing import Optional
 
-from ..observability import MetricLogger, RetraceWatchdog
+from ..observability import MetricLogger, PhaseTimer, RetraceWatchdog
 from .admission import AdmissionController
 from .batching import MicroBatcher
 from .engine import InferenceEngine, bucket_phase
-
-
 from .stats import agg_stats, agg_update, agg_zero, window_stats
 
 
-class ServeTelemetry:
+class ServeTelemetryBase:
+    """Shared serve-record plumbing over (timer, watchdog, admission,
+    logger): compile-delta accumulation against the armed baseline,
+    per-bucket window assembly, the requests section, and the
+    request-latency drain. Subclasses provide `_pop_completed()` (their
+    source of resolved PendingResults) and `_emit_cost_records()`
+    (their per-executable cost ledger)."""
+
+    def __init__(self, timer: PhaseTimer,
+                 admission: Optional[AdmissionController] = None,
+                 logger: Optional[MetricLogger] = None,
+                 watchdog: Optional[RetraceWatchdog] = None):
+        self.timer = timer
+        self.admission = admission
+        self.logger = logger
+        self.watchdog = watchdog if watchdog is not None else \
+            RetraceWatchdog()
+        self.post_warmup_compiles = 0
+        self._armed = False
+        self._latency_agg = agg_zero()
+        self.flush_count = 0
+
+    # hooks ------------------------------------------------------------- #
+    def _pop_completed(self):
+        return []
+
+    def _emit_cost_records(self):
+        pass
+
+    # shared assembly ---------------------------------------------------- #
+    def arm(self, emit_cost_records: bool = True):
+        """Baseline the compile counter after warmup: every compile
+        event from here on counts against the zero-post-warmup
+        contract. Also streams the per-executable `cost` ledger (one
+        schema'd record per warmed-up bucket) so serving capacity
+        planning reads memory-per-bucket off the record stream, not a
+        debugger."""
+        self.watchdog.check()        # first check arms the watchdog
+        self._armed = True
+        if emit_cost_records and self.logger is not None:
+            self._emit_cost_records()
+
+    def _check_runtime(self) -> dict:
+        """Watchdog snapshot + armed compile-delta accumulation (shared
+        by flush AND close so a straggler drain cannot escape the
+        verdict)."""
+        runtime = self.watchdog.check()
+        if self._armed:
+            self.post_warmup_compiles += runtime['compile_events_delta']
+        return runtime
+
+    def _bucket_windows(self, buckets) -> dict:
+        """The serve record's `buckets` section off the shared timer's
+        window percentiles (resets the window)."""
+        timing = self.timer.window_summary()
+        return {str(b): timing[bucket_phase(b)]
+                for b in buckets if bucket_phase(b) in timing}
+
+    def _requests_section(self, served: int) -> dict:
+        requests = dict(
+            served=served,
+            rejected=(self.admission.snapshot()['rejected']
+                      if self.admission else {}),
+        )
+        if self.admission is not None:
+            requests['admitted'] = self.admission.admitted
+        return requests
+
+    def _drain_latencies(self):
+        ms = [p.latency_s * 1e3 for p in self._pop_completed()
+              if p.latency_s is not None]
+        agg_update(self._latency_agg, ms)
+        return ms
+
+    def _emit(self, kind: str, fields: dict) -> dict:
+        if kind == 'serve':
+            self.flush_count += 1
+        if self.logger is not None:
+            return self.logger.log_record(kind, **fields)
+        return fields
+
+
+class ServeTelemetry(ServeTelemetryBase):
     """Wire an engine + batcher + admission controller into the JSONL
     telemetry stream.
 
@@ -46,63 +132,29 @@ class ServeTelemetry:
                  admission: Optional[AdmissionController] = None,
                  logger: Optional[MetricLogger] = None,
                  watchdog: Optional[RetraceWatchdog] = None):
+        super().__init__(engine.timer, admission, logger, watchdog)
         self.engine = engine
         self.batcher = batcher
-        self.admission = admission
-        self.logger = logger
-        self.watchdog = watchdog if watchdog is not None else \
-            RetraceWatchdog()
         for key, executable in engine.executables.items():
             self.watchdog.track(f'bucket_{key[0]}', executable)
-        self.post_warmup_compiles = 0
-        self._armed = False
-        self._latency_agg = agg_zero()
-        self.flush_count = 0
 
-    # ------------------------------------------------------------------ #
-    def arm(self, emit_cost_records: bool = True):
-        """Baseline the compile counter after warmup: every compile event
-        from here on counts against the zero-post-warmup contract.
+    def _pop_completed(self):
+        return self.batcher.pop_completed() if self.batcher is not None \
+            else []
 
-        Also streams the engine's per-bucket `cost` ledger (one
-        schema'd record per warmed-up executable — peak HBM split,
-        flops, collective bytes) so serving capacity planning reads
-        memory-per-bucket off the record stream, not a debugger."""
-        self.watchdog.check()        # first check arms the watchdog
-        self._armed = True
-        if emit_cost_records and self.logger is not None:
-            for key in sorted(self.engine.cost_payloads):
-                self.logger.log_record('cost', mirror=False,
-                                       **self.engine.cost_payloads[key])
-
-    def _drain_latencies(self):
-        if self.batcher is None:
-            return []
-        ms = [p.latency_s * 1e3 for p in self.batcher.pop_completed()
-              if p.latency_s is not None]
-        agg_update(self._latency_agg, ms)
-        return ms
+    def _emit_cost_records(self):
+        for key in sorted(self.engine.cost_payloads):
+            self.logger.log_record('cost', mirror=False,
+                                   **self.engine.cost_payloads[key])
 
     def flush(self) -> dict:
         """One schema'd `serve` record: per-bucket window percentiles,
         request counters, queue depth, watchdog snapshot."""
-        timing = self.engine.timer.window_summary()
-        buckets = {str(b): timing[bucket_phase(b)]
-                   for b in self.engine.buckets
-                   if bucket_phase(b) in timing}
-        runtime = self.watchdog.check()
-        if self._armed:
-            self.post_warmup_compiles += runtime['compile_events_delta']
-        requests = dict(
-            served=sum(self.engine.rows_served.values()),
-            rejected=(self.admission.snapshot()['rejected']
-                      if self.admission else {}),
-        )
-        if self.admission is not None:
-            requests['admitted'] = self.admission.admitted
+        runtime = self._check_runtime()
         fields = dict(
-            requests=requests,
-            buckets=buckets,
+            requests=self._requests_section(
+                sum(self.engine.rows_served.values())),
+            buckets=self._bucket_windows(self.engine.buckets),
             queue_depth=(self.batcher.queue_depth
                          if self.batcher is not None else 0),
             runtime=runtime,
@@ -111,10 +163,7 @@ class ServeTelemetry:
         latencies = self._drain_latencies()
         if latencies:
             fields['request_latency_ms'] = window_stats(latencies)
-        self.flush_count += 1
-        if self.logger is not None:
-            return self.logger.log_record('serve', **fields)
-        return fields
+        return self._emit('serve', fields)
 
     def close(self) -> dict:
         """Cumulative `summary` record: total batches, request-latency /
@@ -122,9 +171,7 @@ class ServeTelemetry:
         engine's compile/serve counters, and the compile-event verdict."""
         # a FINAL watchdog check: compile events between the last flush
         # and close (e.g. a straggler drain) must not escape the verdict
-        runtime = self.watchdog.check()
-        if self._armed:
-            self.post_warmup_compiles += runtime['compile_events_delta']
+        self._check_runtime()
         self._drain_latencies()
         metrics = dict(request_latency_ms=agg_stats(self._latency_agg))
         if self.batcher is not None:
@@ -134,13 +181,11 @@ class ServeTelemetry:
                    if self.batcher is not None
                    else sum(self.engine.batches_served.values())),
             metrics=metrics,
-            timing=self.engine.timer.cumulative_summary(),
+            timing=self.timer.cumulative_summary(),
             engine=self.engine.stats(),
             post_warmup_compiles=self.post_warmup_compiles,
             retrace_warnings_total=self.watchdog.warnings_total,
         )
         if self.admission is not None:
             fields['requests'] = self.admission.snapshot()
-        if self.logger is not None:
-            return self.logger.log_record('summary', **fields)
-        return fields
+        return self._emit('summary', fields)

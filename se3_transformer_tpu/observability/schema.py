@@ -28,7 +28,14 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    max_ms} — SLO percentiles are load-bearing, so p99 is
                    REQUIRED here), queue_depth, runtime (watchdog
                    snapshot), post_warmup_compiles (REQUIRED — the AOT
-                   zero-compile contract rides this field).
+                   zero-compile contract rides this field). Multi-
+                   replica runs (serving.RouterTelemetry) fold in the
+                   cross-replica aggregation fields, validated when
+                   present: replicas (per-replica-id {depth, ...}),
+                   swaps ({count, events} — rolling weight-swap
+                   evidence), continuous_admissions (int — requests
+                   admitted into an already-open in-flight bucket slot,
+                   the continuous-batching proof counter).
   tune             one per kernel-autotuner candidate
                    (scripts/tune_kernels.py): kernel kind + shape,
                    candidate blocks, the end-to-end step_ms /
@@ -188,6 +195,30 @@ def validate_record(rec: dict, index=None) -> dict:
                 _fail(index, f'buckets[{bucket!r}] missing {missing} '
                              f'(per-bucket p50/p95/p99 are the SLO '
                              f'surface)')
+        # multi-replica aggregation fields (serving.RouterTelemetry)
+        # are optional but validated when present
+        if 'continuous_admissions' in rec:
+            ca = rec['continuous_admissions']
+            if not isinstance(ca, int) or isinstance(ca, bool) or ca < 0:
+                _fail(index, f'serve.continuous_admissions must be a '
+                             f'non-negative int, got {ca!r}')
+        if 'replicas' in rec:
+            replicas = rec['replicas']
+            if not isinstance(replicas, dict):
+                _fail(index, 'serve.replicas must be an object '
+                             '(replica id -> snapshot)')
+            for rid, snap in replicas.items():
+                if not isinstance(snap, dict) or 'depth' not in snap:
+                    _fail(index, f'replicas[{rid!r}] must carry depth '
+                                 f'(per-replica depth IS the load '
+                                 f'surface)')
+        if 'swaps' in rec:
+            swaps = rec['swaps']
+            if not isinstance(swaps, dict) \
+                    or not isinstance(swaps.get('count'), int) \
+                    or not isinstance(swaps.get('events'), list):
+                _fail(index, f'serve.swaps must carry an int count and '
+                             f'an events list, got {swaps!r}')
     if kind == 'tune':
         if rec['verdict'] not in _TUNE_VERDICTS:
             _fail(index, f'tune.verdict {rec["verdict"]!r} not in '

@@ -5,6 +5,10 @@ from .exchange import (
     analyze_hlo_comm, bonded_priority_mask, comm_payload,
     exchange_index_select, exchange_scope, neighbor_gather, rowwise_gather,
 )
+from .rules import (
+    RULE_SETS, fsdp_rules, match_partition_rules, place_with_rules,
+    replicated_rules, resolve_rules, tp_rules,
+)
 from .sharding import (
     make_sharded_train_step, make_accumulating_train_step, replicated,
     param_partition_specs, shard_params,

@@ -1,0 +1,240 @@
+"""Declarative partition rules: ordered regex-on-param-path -> PartitionSpec.
+
+ROADMAP item 3's load-bearing refactor: ONE source of sharding truth that
+training (`parallel.sharding`) and serving (`inference.engine`,
+`serving.*`) both consult, replacing the ad-hoc per-site spec function
+that lived inside `param_partition_specs`. The shape follows the serving
+sharding maps in SNIPPETS.md [2] and [3]: an ordered list of
+``(regex, PartitionSpec[, ndim])`` rules matched against the
+``'/'``-joined flax parameter path, **first match wins**, with a LOUD
+audit for leaves no rule covers — a silently-replicated tensor is the
+classic way "sharded serving" degrades into every chip doing the same
+work.
+
+Three built-in rule sets over the existing ``('dp', 'sp', 'tp')`` mesh
+axes (`RULE_SETS`):
+
+  * ``replicated`` — everything P() (the PR 2 serving default);
+  * ``tp``         — the Megatron column/row pattern the old
+                     `param_partition_specs` hand-coded: radial final
+                     weights/biases shard their output-channel axis,
+                     attention/FF in-projections column-shard the head
+                     axis, out-projections row-shard the input axis
+                     (one psum per block);
+  * ``fsdp``       — every non-scalar shards dim 0 over the dp axis
+                     (parameter memory / replica-count lever; optimizer
+                     state inherits the same specs for true FSDP).
+
+`match_partition_rules(rules, params, mesh=...)` additionally audits
+each matched spec against the leaf shape and the mesh: a spec whose
+rank-guard fails falls through to the NEXT rule (so ``w3`` with an
+unexpected rank ends at the catch-all, exactly like the old per-site
+``ndim`` checks); a sharded dimension that does not divide its mesh
+axis — or a mesh axis of size 1 — demotes to replication for that
+dimension, collected into one summary warning. The result is pure and
+inspectable: a pytree of PartitionSpec, no placement side effects.
+"""
+from __future__ import annotations
+
+import re
+import warnings
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (pattern, spec) or (pattern, spec, required_ndim)
+Rule = Union[Tuple[str, P], Tuple[str, P, int]]
+Rules = Sequence[Rule]
+
+ON_UNMATCHED = ('error', 'warn', 'replicate')
+
+# Megatron-style column/row families over the flax param tree (the
+# comment block that documented these in parallel/sharding.py now lives
+# as data): column-parallel = output (head/hidden) axis sharded,
+# row-parallel = input axis sharded so the contraction psums over ICI.
+_COLUMN_PARALLEL = ('to_q', 'to_self_k', 'to_self_v', 'to_global_k',
+                    'to_global_v', 'to_k', 'project_in', 'self_interact')
+_ROW_PARALLEL = ('to_out', 'project_out')
+
+
+def path_of(key_path) -> str:
+    """'/'-joined string form of a tree_map_with_path key path."""
+    parts = []
+    for k in key_path:
+        parts.append(str(getattr(k, 'key', getattr(k, 'name', k))))
+    return '/'.join(parts)
+
+
+# --------------------------------------------------------------------- #
+# built-in rule sets
+# --------------------------------------------------------------------- #
+def replicated_rules(axis: Optional[str] = None) -> Rules:
+    """Everything replicated (the single-chip / PR 2 serving layout)."""
+    return ((r'.*', P()),)
+
+
+def tp_rules(axis: str = 'tp') -> Rules:
+    """Tensor parallelism over `axis` — the rule-set form of the old
+    ad-hoc `param_partition_specs` body. Rank guards reproduce its
+    exact ndim checks: a name-match with the wrong rank falls through
+    to the catch-all replication rule."""
+    col = '|'.join(_COLUMN_PARALLEL)
+    row = '|'.join(_ROW_PARALLEL)
+    return (
+        # radial final weight [mid, c_in*F, c_out] — both the per-pair
+        # 'w3'/'b3' (PairwiseConvSE3) and the shared-trunk group layout
+        # 'w3_{d_in}_{d_out}' (ConvSE3): shard the OUTPUT channel axis
+        (r'(^|/)w3(_\d+_\d+)?$', P(None, None, axis), 3),
+        (r'(^|/)b3(_\d+_\d+)?$', P(None, axis), 2),
+        # attention/FF in-projections: column-shard the output axis
+        # (= heads * dim_head, i.e. head sharding)
+        (rf'(^|/)(?:{col})/w\d+$', P(None, axis), 2),
+        # out-projections: row-shard the INPUT axis — the classic
+        # column->row pair with one psum per block
+        (rf'(^|/)(?:{row})/w\d+$', P(axis, None), 2),
+        # everything else (norms, embeddings, gates) is tiny: replicate
+        (r'.*', P()),
+    )
+
+
+def fsdp_rules(axis: str = 'dp') -> Rules:
+    """Fully-sharded parameters: every non-scalar leaf shards dim 0
+    over `axis` (indivisible dims demote to replication under the mesh
+    audit). Applied to optimizer state too, this is true FSDP — the
+    ROADMAP item 5 extension rides the same rule set."""
+    return ((r'.*', P(axis)),)
+
+
+RULE_SETS = dict(replicated=replicated_rules, tp=tp_rules,
+                 fsdp=fsdp_rules)
+
+
+def resolve_rules(rules: Union[str, Rules],
+                  axis: Optional[str] = None) -> Rules:
+    """A rule set by name ('replicated' | 'tp' | 'fsdp') or an explicit
+    rule list, normalized to a tuple of rules. `axis` overrides a named
+    set's default mesh axis; combining it with an explicit rule list is
+    an error (the list already names its axes) — never a silent drop."""
+    if isinstance(rules, str):
+        if rules not in RULE_SETS:
+            raise KeyError(f'unknown rule set {rules!r} '
+                           f'(built-ins: {sorted(RULE_SETS)})')
+        factory = RULE_SETS[rules]
+        return factory(axis) if axis is not None else factory()
+    if axis is not None:
+        raise ValueError('axis= only applies to a NAMED rule set; an '
+                         'explicit rule list already names its axes')
+    return tuple(rules)
+
+
+# --------------------------------------------------------------------- #
+# the matcher
+# --------------------------------------------------------------------- #
+def match_partition_rules(rules: Union[str, Rules], params,
+                          mesh: Optional[Mesh] = None,
+                          on_unmatched: str = 'error'):
+    """PartitionSpec pytree for `params` under first-match-wins rules.
+
+    * Scalar leaves (rank 0 or a single element) are never worth a
+      collective: they get P() without consuming a rule.
+    * A rule with a rank guard only matches leaves of that rank;
+      otherwise scanning continues with the next rule.
+    * `on_unmatched` ('error' by default — the audit is LOUD): a leaf
+      no rule matches raises, listing the offending paths; 'warn'
+      replicates with one summary warning; 'replicate' is the silent
+      opt-out for throwaway trees.
+    * With `mesh`, matched specs are audited against leaf shapes: a
+      sharded dimension that does not divide its mesh axis demotes to
+      replication for that dimension (one summary warning names every
+      demotion); axes of size 1 are dropped silently — sharding over a
+      size-1 axis is replication wearing a costume, and dropping it
+      keeps tp=1 configs bit-identical to the replicated path. An axis
+      name the mesh does not carry is a configuration error and raises.
+    """
+    if on_unmatched not in ON_UNMATCHED:
+        raise ValueError(f'on_unmatched={on_unmatched!r} not in '
+                         f'{ON_UNMATCHED}')
+    compiled = []
+    for rule in resolve_rules(rules):
+        pat, spec = rule[0], rule[1]
+        ndim = rule[2] if len(rule) > 2 else None
+        compiled.append((re.compile(pat), spec, ndim))
+    unmatched, demoted = [], []
+    axis_sizes = (dict(zip(mesh.axis_names, mesh.devices.shape))
+                  if mesh is not None else None)
+
+    def audit(name, spec, shape):
+        if axis_sizes is None:
+            return spec
+        if len(spec) > len(shape):
+            demoted.append(f'{name}: spec {spec} exceeds rank '
+                           f'{len(shape)}')
+            return P()
+        fixed = []
+        for d, ax in enumerate(spec):
+            if ax is None:
+                fixed.append(None)
+                continue
+            axes = (ax,) if isinstance(ax, str) else tuple(ax)
+            missing = [a for a in axes if a not in axis_sizes]
+            if missing:
+                raise ValueError(
+                    f'partition rule for {name!r} names mesh axis '
+                    f'{missing} but the mesh only carries '
+                    f'{sorted(axis_sizes)}')
+            size = int(np.prod([axis_sizes[a] for a in axes]))
+            if size == 1:
+                fixed.append(None)           # size-1 axis: drop quietly
+            elif shape[d] % size:
+                demoted.append(f'{name}: dim {d} (size {shape[d]}) does '
+                               f'not divide {"*".join(axes)} ({size})')
+                fixed.append(None)
+            else:
+                fixed.append(ax)
+        return P(*fixed)
+
+    def assign(key_path, leaf):
+        name = path_of(key_path)
+        shape = tuple(getattr(leaf, 'shape', ()) or ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        for pat, spec, ndim in compiled:
+            if ndim is not None and len(shape) != ndim:
+                continue
+            if pat.search(name):
+                return audit(name, spec, shape)
+        unmatched.append(name)
+        return P()
+
+    specs = jax.tree_util.tree_map_with_path(assign, params)
+    if unmatched:
+        msg = (f'{len(unmatched)} parameter leaves matched NO partition '
+               f'rule (e.g. {unmatched[:5]}); end the rule list with '
+               f"('.*', P()) to replicate the remainder explicitly")
+        if on_unmatched == 'error':
+            raise ValueError(msg)
+        if on_unmatched == 'warn':
+            warnings.warn(msg, stacklevel=2)
+    if demoted:
+        shown = '; '.join(demoted[:8])
+        more = f' (+{len(demoted) - 8} more)' if len(demoted) > 8 else ''
+        warnings.warn(f'partition rules demoted {len(demoted)} '
+                      f'dimension(s) to replication: {shown}{more}',
+                      stacklevel=2)
+    return specs
+
+
+def place_with_rules(params, mesh: Mesh, rules: Union[str, Rules],
+                     on_unmatched: str = 'error'):
+    """Match rules, then device_put every leaf into its NamedSharding.
+    Returns (placed_params, specs) — the specs ride along so callers
+    (e.g. the AOT engine) can build sharded abstract values without
+    re-matching."""
+    specs = match_partition_rules(rules, params, mesh=mesh,
+                                  on_unmatched=on_unmatched)
+    placed = jax.tree_util.tree_map(
+        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+        params, specs)
+    return placed, specs

@@ -10,7 +10,6 @@ collectives rather than a hand-rolled NCCL/MPI layer.
 """
 from __future__ import annotations
 
-import re
 import warnings
 from typing import Callable, Optional
 
@@ -42,71 +41,36 @@ def _expect_unusable_batch_donation():
 # tensor parallelism (SURVEY §5 "optional tensor sharding of the
 # radial-MLP and head axes")
 #
-# Megatron-style column/row rules over the flax param tree:
-#   * PairwiseConvSE3 radial output weight w3 [mid, c_in*F, c_out] and its
-#     bias b3 [c_in*F, c_out] shard over the OUTPUT channel axis — this is
-#     the big tensor (mid=128 x IF x O per degree pair) and the conv
-#     output it produces is then tp-sharded over channels;
-#   * attention in-projections (to_q / to_self_k / to_self_v /
-#     to_global_k / to_global_v / linear to_k) column-shard their output
-#     axis (= heads * dim_head, so this is head sharding);
-#   * to_out / feed-forward project_out row-shard their INPUT axis, so
-#     the contraction over the sharded hidden axis lowers to a psum over
-#     ICI — the classic column->row pair with one collective per block.
-# Everything else (norms, embeddings, gates) is tiny and replicated.
-# GSPMD propagates activation shardings from these param shardings; axes
-# that do not divide tp stay replicated (loudly documented, not silent:
-# param_partition_specs is pure and inspectable).
+# The Megatron-style column/row rules that used to be hand-coded here
+# now live as data in `parallel.rules.tp_rules` — serving
+# (inference.engine, serving.*) consults the SAME rule engine, so
+# training and serving shardings cannot drift. These two functions are
+# thin callers kept for the established call sites.
 # ---------------------------------------------------------------------- #
-_COLUMN_PARALLEL = frozenset({
-    'to_q', 'to_self_k', 'to_self_v', 'to_global_k', 'to_global_v',
-    'to_k', 'project_in', 'self_interact'})
-_ROW_PARALLEL = frozenset({'to_out', 'project_out'})
-_LINEAR_W = re.compile(r'w\d+$')
-_RADIAL_W3 = re.compile(r'^w3(_\d+_\d+)?$')
-_RADIAL_B3 = re.compile(r'^b3(_\d+_\d+)?$')
+def param_partition_specs(params, mesh: Mesh, axis: Optional[str] = None,
+                          rules=None):
+    """Rule-engine-backed PartitionSpec tree for a model param pytree
+    (see `parallel.rules`). Default rules: the built-in tensor-parallel
+    set; `rules` may name another built-in set ('replicated' | 'tp' |
+    'fsdp') or pass an explicit rule list. `axis` overrides the named
+    set's own default mesh axis ('tp' for tp rules, 'dp' for fsdp) and
+    is forwarded to the set factory — never silently dropped.
+    Dimensions that do not divide their mesh axis demote to replication
+    (audited with a summary warning, never silent)."""
+    from .rules import match_partition_rules, resolve_rules, tp_rules
+    if rules is None:
+        rules = tp_rules(axis) if axis is not None else tp_rules()
+    else:
+        rules = resolve_rules(rules, axis)
+    return match_partition_rules(rules, params, mesh=mesh)
 
 
-def _path_names(path):
-    out = []
-    for k in path:
-        out.append(getattr(k, 'key', getattr(k, 'name', str(k))))
-    return out
-
-
-def param_partition_specs(params, mesh: Mesh, axis: str = 'tp'):
-    """Rule-based tensor-parallel PartitionSpec tree for a model param
-    pytree. Leaves whose sharded dimension does not divide the tp axis
-    size fall back to replication (P())."""
-    tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
-
-    def rule(path, leaf):
-        if tp <= 1 or not hasattr(leaf, 'shape'):
-            return P()
-        names = _path_names(path)
-        name = names[-1]
-        parent = names[-2] if len(names) > 1 else ''
-        # radial final weights: per-pair 'w3'/'b3' (PairwiseConvSE3) and
-        # the shared-trunk group layout 'w3_{d_in}_{d_out}' (ConvSE3)
-        if _RADIAL_W3.match(name) and leaf.ndim == 3 \
-                and leaf.shape[2] % tp == 0:
-            return P(None, None, axis)
-        if _RADIAL_B3.match(name) and leaf.ndim == 2 \
-                and leaf.shape[1] % tp == 0:
-            return P(None, axis)
-        if _LINEAR_W.match(name) and leaf.ndim == 2:
-            if parent in _COLUMN_PARALLEL and leaf.shape[1] % tp == 0:
-                return P(None, axis)
-            if parent in _ROW_PARALLEL and leaf.shape[0] % tp == 0:
-                return P(axis, None)
-        return P()
-
-    return jax.tree_util.tree_map_with_path(rule, params)
-
-
-def shard_params(params, mesh: Mesh, axis: str = 'tp'):
-    """Place a param pytree on the mesh with tensor-parallel sharding."""
-    specs = param_partition_specs(params, mesh, axis)
+def shard_params(params, mesh: Mesh, axis: Optional[str] = None,
+                 rules=None):
+    """Place a param pytree on the mesh with rule-engine sharding
+    (tensor-parallel by default; `axis`/`rules` as in
+    `param_partition_specs`)."""
+    specs = param_partition_specs(params, mesh, axis, rules=rules)
     return jax.tree_util.tree_map(
         lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
         params, specs)

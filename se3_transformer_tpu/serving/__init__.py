@@ -1,0 +1,32 @@
+"""Multi-replica sharded serving: continuous batching over a device mesh.
+
+The scale-out layer above `inference/` (ROADMAP item 3). Where PR 2
+built one replica — AOT bucketed engine, micro-batcher with deadline
+flushes, admission control — this package multiplies and upgrades it:
+
+  * `replica`  — `ContinuousBatcher`: requests admit into partially-
+    filled **in-flight** bucket slots; a slot dispatches the moment it
+    fills (inside `admit` — no flush barrier), with the deadline only
+    as a fallback for slots that never fill. `ReplicaWorker` pairs a
+    batcher with its `InferenceEngine` and owns `drain()` /
+    `swap_weights()` (zero-recompile weight hot-reload).
+  * `router`   — `Router`: least-outstanding dispatch across N replica
+    workers, structured shedding via the PR 2 `AdmissionController`,
+    rolling weight swaps (one replica drains at a time while the rest
+    keep serving — zero dropped requests), `swap_from_checkpoint` off
+    the training-side async-checkpoint path.
+  * `telemetry` — `RouterTelemetry`: cross-replica SLO aggregation
+    folded into the existing schema'd `serve` record — aggregate
+    per-bucket p50/p95/p99 (one shared PhaseTimer), per-replica depth,
+    swap events, and the `continuous_admissions` proof counter.
+
+Sharding composes orthogonally: each replica's engine may carry a mesh
+and a `parallel.rules` rule set ('tp' / 'fsdp'), so one large model
+spans chips (TP/FSDP) while DP replicas multiply throughput.
+
+Entry point: `scripts/serve.py --replicas N`; smoke gate:
+`make serve-multi-smoke`.
+"""
+from .replica import ContinuousBatcher, ReplicaWorker  # noqa: F401
+from .router import Router  # noqa: F401
+from .telemetry import RouterTelemetry  # noqa: F401

@@ -1,0 +1,233 @@
+"""Replica worker: one engine plus its in-flight bucket slots.
+
+The PR 2 `MicroBatcher` queues requests per bucket and FLUSHES — on
+batch-full or on a deadline — which makes the deadline a structural part
+of the dispatch path: a serve loop that wants low latency must pump
+aggressively, and a drain is a barrier over every queue. Continuous
+batching inverts that: each bucket owns an open **slot** (a partially
+filled, in-flight batch) that requests are admitted into at any time; a
+slot dispatches the MOMENT it fills, inside `admit` itself, and the
+deadline exists only as a FALLBACK for slots that never fill (counted
+separately — `deadline_flushes` on a healthy loaded replica stays near
+zero while `continuous_admissions` grows).
+
+`ReplicaWorker` pairs a `ContinuousBatcher` with the `InferenceEngine`
+that executes its slots, and owns the replica-local lifecycle verbs the
+router composes: `drain()` (dispatch every partial slot) and
+`swap_weights()` (drain, then re-point the engine at new params — AOT
+executables take params as a call argument, so a swap costs zero
+recompiles; the engine's params setter re-places into the same
+partition-rule shardings).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..inference.batching import PendingResult, dispatch_batch
+
+
+class _Slot:
+    """One in-flight bucket batch: open for admission until full.
+    (Deadlines key off each request's own `submitted_at`, not slot
+    age — a slot carries no clock state.)"""
+
+    __slots__ = ('bucket', 'tokens', 'coords', 'pending')
+
+    def __init__(self, bucket: int):
+        self.bucket = bucket
+        self.tokens: List[np.ndarray] = []
+        self.coords: List[np.ndarray] = []
+        self.pending: List[PendingResult] = []
+
+    def __len__(self):
+        return len(self.pending)
+
+
+class ContinuousBatcher:
+    """Admit requests into partially-filled in-flight bucket slots.
+
+        cb = ContinuousBatcher(engine.run, engine.buckets,
+                               engine.batch_size, max_wait_ms=50.0)
+        cb.admit(bucket, tokens, coords, pending)  # dispatches on fill
+        cb.flush_due()                             # deadline FALLBACK
+        cb.drain()                                 # shutdown / swap
+
+    There is no flush barrier: a slot that fills dispatches inside
+    `admit` (the `continuous_admissions` counter records every request
+    that joined an already-open slot — the proof continuous batching is
+    actually happening), and `flush_due` only exists so a trickle of
+    requests that never fills a slot still answers within
+    `max_wait_ms`. The runner contract and error semantics ARE
+    `MicroBatcher`'s: both route through the shared
+    `inference.batching.dispatch_batch` (pad / slice-to-true-rows /
+    resolve-every-request-on-a-raising-runner), so the two batchers
+    cannot drift.
+    """
+
+    def __init__(self, runner: Callable, buckets: Sequence[int],
+                 batch_size: int, max_wait_ms: float = 50.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.runner = runner
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        assert self.buckets, 'no buckets'
+        self.batch_size = int(batch_size)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.clock = clock
+        self._slots: Dict[int, _Slot] = {}
+        self.continuous_admissions = 0   # joined an in-flight slot
+        self.deadline_flushes = 0        # fallback dispatches
+        self.batches_dispatched = 0
+        self.rows_dispatched = 0         # real (non-dummy) rows
+        # completed results: drained by telemetry via pop_completed();
+        # bounded like MicroBatcher.completed (submitters keep their
+        # own PendingResult either way)
+        self.completed: List[PendingResult] = []
+        self._completed_capacity = 65536
+
+    # ------------------------------------------------------------------ #
+    @property
+    def depth(self) -> int:
+        """Requests sitting in open slots (not yet dispatched)."""
+        return sum(len(s) for s in self._slots.values())
+
+    def admit(self, bucket: int, tokens, coords,
+              pending: PendingResult) -> PendingResult:
+        """Admit one request into its bucket's in-flight slot; the slot
+        dispatches immediately (no pump, no barrier) when it fills."""
+        assert bucket in self.buckets, f'{bucket} is not a configured bucket'
+        slot = self._slots.get(bucket)
+        if slot is None:
+            slot = self._slots[bucket] = _Slot(bucket)
+        elif slot.pending:
+            self.continuous_admissions += 1
+        slot.tokens.append(np.asarray(tokens))
+        slot.coords.append(np.asarray(coords, np.float32).reshape(-1, 3))
+        slot.pending.append(pending)
+        if len(slot) >= self.batch_size:
+            self._dispatch(slot)
+        return pending
+
+    def flush_due(self, now: Optional[float] = None) -> int:
+        """Deadline FALLBACK: dispatch every slot whose oldest request
+        has waited `max_wait_ms`. Returns batches dispatched."""
+        now = self.clock() if now is None else now
+        n = 0
+        for slot in list(self._slots.values()):
+            if slot.pending and \
+                    now - slot.pending[0].submitted_at >= self.max_wait_s:
+                self._dispatch(slot)
+                self.deadline_flushes += 1
+                n += 1
+        return n
+
+    def drain(self) -> int:
+        """Dispatch every non-empty slot (shutdown / weight swap)."""
+        n = 0
+        for slot in list(self._slots.values()):
+            if slot.pending:
+                self._dispatch(slot)
+                n += 1
+        return n
+
+    def next_deadline(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds until the earliest fallback deadline; None when idle."""
+        oldest = [s.pending[0].submitted_at
+                  for s in self._slots.values() if s.pending]
+        if not oldest:
+            return None
+        now = self.clock() if now is None else now
+        return max(0.0, min(oldest) + self.max_wait_s - now)
+
+    def pop_completed(self) -> List[PendingResult]:
+        done, self.completed = self.completed, []
+        return done
+
+    # ------------------------------------------------------------------ #
+    def _dispatch(self, slot: _Slot):
+        # the slot closes the moment it dispatches; the next admit for
+        # this bucket opens a fresh one (on a raising runner the
+        # requests resolve done-with-error, never silently re-slotted)
+        pending = slot.pending
+        self._slots.pop(slot.bucket, None)
+        dispatch_batch(self.runner, slot.bucket, self.batch_size,
+                       slot.tokens, slot.coords, pending,
+                       self.completed, self._completed_capacity,
+                       self.clock)
+        self.batches_dispatched += 1
+        self.rows_dispatched += len(pending)
+
+
+class ReplicaWorker:
+    """One serving replica: an engine plus its continuous batcher.
+
+        worker = ReplicaWorker(0, engine, max_wait_ms=50.0)
+        worker.admit(bucket, tokens, coords, pending)
+        worker.swap_weights(new_params)     # drain, re-point, zero drops
+
+    `outstanding` (requests admitted but unanswered) is the router's
+    least-outstanding load signal; `draining=True` takes the replica
+    out of dispatch rotation while a swap is in flight.
+    """
+
+    def __init__(self, replica_id: int, engine, *,
+                 max_wait_ms: float = 50.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.id = int(replica_id)
+        self.engine = engine
+        self.batcher = ContinuousBatcher(
+            engine.run, engine.buckets, engine.batch_size,
+            max_wait_ms=max_wait_ms, clock=clock)
+        self.draining = False
+        self.swaps = 0
+
+    # ------------------------------------------------------------------ #
+    @property
+    def outstanding(self) -> int:
+        return self.batcher.depth
+
+    @property
+    def served_rows(self) -> int:
+        return int(sum(self.engine.rows_served.values()))
+
+    def admit(self, bucket: int, tokens, coords,
+              pending: PendingResult) -> PendingResult:
+        assert not self.draining, \
+            f'replica {self.id} is draining — the router must not ' \
+            f'admit into it'
+        return self.batcher.admit(bucket, tokens, coords, pending)
+
+    def flush_due(self, now=None) -> int:
+        return self.batcher.flush_due(now)
+
+    def drain(self) -> int:
+        return self.batcher.drain()
+
+    def swap_weights(self, params) -> dict:
+        """Drain the in-flight slots (old weights answer everything
+        already admitted), then re-point the engine at `params`. AOT
+        executables take params as a call argument, so the swap
+        compiles NOTHING — the engine's params setter re-places into
+        the same partition-rule shardings. Returns the swap event for
+        the telemetry stream."""
+        self.draining = True
+        try:
+            drained = self.batcher.drain()
+            self.engine.params = params
+        finally:
+            self.draining = False
+        self.swaps += 1
+        return dict(replica=self.id, drained_batches=drained,
+                    swap_index=self.swaps)
+
+    def snapshot(self) -> dict:
+        """Per-replica depth/served/swap counters for the serve record."""
+        return dict(depth=self.batcher.depth,
+                    served=self.served_rows,
+                    batches=self.batcher.batches_dispatched,
+                    continuous_admissions=self.batcher.continuous_admissions,
+                    deadline_flushes=self.batcher.deadline_flushes,
+                    swaps=self.swaps,
+                    draining=self.draining)

@@ -1,0 +1,171 @@
+"""Continuous-batching router over N replica workers.
+
+The millions-of-users front door (ROADMAP item 3): each replica owns a
+sharded (or replicated) AOT engine; the router multiplies their
+throughput with three policies, all deterministic and clock-injectable:
+
+  * **continuous admission** — `submit` places a request straight into
+    the chosen replica's in-flight bucket slot (`ContinuousBatcher`);
+    a full slot dispatches inside `submit`, the deadline (`pump`) is
+    only the fallback for slots that never fill;
+  * **least-outstanding dispatch** — among non-draining replicas, the
+    one with the fewest unanswered requests wins (ties break to the
+    lowest replica id, so a single-replica router degenerates exactly
+    to its batcher);
+  * **rolling weight swaps** — `swap_weights` walks the replicas ONE AT
+    A TIME: take the replica out of rotation, drain its slots (old
+    weights answer everything already admitted), re-point its engine at
+    the new params (zero recompiles — AOT executables take params as an
+    argument), put it back. The other replicas keep serving throughout,
+    so a checkpoint hot-reload (`swap_from_checkpoint`, off the
+    training-side async-checkpoint path) drops zero requests.
+
+Structured shedding reuses the PR 2 `AdmissionController` — oversize
+and overload rejections raise `RequestRejected` before touching any
+compiled path, counted for the serve record.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..inference.admission import (
+    AdmissionController, fit_bucket, oversize_error,
+)
+from ..inference.batching import PendingResult
+from .replica import ReplicaWorker
+
+
+class Router:
+    """Admission + placement + lifecycle over a fleet of replicas.
+
+        workers = [ReplicaWorker(i, engine_i) for i ...]
+        router = Router(workers, admission=ctl)
+        pending = router.submit(tokens, coords)   # may raise
+        router.pump()                             # deadline fallback
+        router.swap_weights(new_params)           # rolling hot-reload
+        router.drain()                            # end of stream
+    """
+
+    def __init__(self, workers: Sequence[ReplicaWorker],
+                 admission: Optional[AdmissionController] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.workers: List[ReplicaWorker] = list(workers)
+        assert self.workers, 'a router needs at least one replica'
+        buckets = {w.engine.buckets for w in self.workers}
+        assert len(buckets) == 1, \
+            f'replicas disagree on buckets: {sorted(buckets)} — the ' \
+            f'router routes by bucket, so every replica must compile ' \
+            f'the same set'
+        self.buckets = self.workers[0].engine.buckets
+        self.admission = admission
+        self.clock = clock
+        self._next_id = 0
+        self.swap_events: List[dict] = []
+
+    # ------------------------------------------------------------------ #
+    @property
+    def queue_depth(self) -> int:
+        return sum(w.outstanding for w in self.workers)
+
+    @property
+    def continuous_admissions(self) -> int:
+        return sum(w.batcher.continuous_admissions for w in self.workers)
+
+    @property
+    def deadline_flushes(self) -> int:
+        return sum(w.batcher.deadline_flushes for w in self.workers)
+
+    @property
+    def batches_dispatched(self) -> int:
+        return sum(w.batcher.batches_dispatched for w in self.workers)
+
+    @property
+    def max_len(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, length: int) -> Optional[int]:
+        return fit_bucket(self.buckets, length)
+
+    # ------------------------------------------------------------------ #
+    def _pick_worker(self) -> ReplicaWorker:
+        """Least-outstanding among non-draining replicas (ties: lowest
+        id — deterministic, and a 1-replica router degenerates to its
+        batcher)."""
+        live = [w for w in self.workers if not w.draining]
+        assert live, 'every replica is draining — rolling swaps take ' \
+                     'one replica out at a time, so this is a bug'
+        return min(live, key=lambda w: (w.outstanding, w.id))
+
+    def submit(self, tokens, coords) -> PendingResult:
+        """Admit + place one request; its slot dispatches on fill.
+
+        Raises RequestRejected (oversize / overloaded) without touching
+        any compiled path; the bucket fit is checked BEFORE admission
+        accounting (same contract as MicroBatcher.submit)."""
+        tokens = np.asarray(tokens)
+        length = len(tokens)
+        bucket = self.bucket_for(length)
+        if bucket is None:
+            if self.admission is not None:
+                self.admission.reject_oversize(length, self.buckets[-1])
+            raise oversize_error(length, self.buckets[-1])
+        if self.admission is not None:
+            self.admission.admit(length, queue_depth=self.queue_depth)
+        worker = self._pick_worker()
+        pending = PendingResult(self._next_id, length, bucket,
+                                self.clock())
+        self._next_id += 1
+        worker.admit(bucket, tokens, coords, pending)
+        return pending
+
+    def pump(self, now: Optional[float] = None) -> int:
+        """Deadline FALLBACK across the fleet: dispatch every slot whose
+        oldest request hit `max_wait_ms`. Returns batches dispatched."""
+        now = self.clock() if now is None else now
+        return sum(w.flush_due(now) for w in self.workers)
+
+    def next_deadline(self, now: Optional[float] = None) -> Optional[float]:
+        """Sleep hint: seconds until the earliest fallback deadline."""
+        now = self.clock() if now is None else now
+        deadlines = [d for d in (w.batcher.next_deadline(now)
+                                 for w in self.workers) if d is not None]
+        return min(deadlines) if deadlines else None
+
+    def drain(self) -> int:
+        """Dispatch every partial slot on every replica (end of
+        stream). Returns batches dispatched."""
+        return sum(w.drain() for w in self.workers)
+
+    def pop_completed(self) -> List[PendingResult]:
+        done: List[PendingResult] = []
+        for w in self.workers:
+            done += w.batcher.pop_completed()
+        return done
+
+    # ------------------------------------------------------------------ #
+    def swap_weights(self, params, tag: Optional[str] = None) -> List[dict]:
+        """Rolling weight swap: one replica at a time drains and
+        re-points at `params` while the rest keep serving. Returns the
+        swap events (also appended to `swap_events` for telemetry)."""
+        events = []
+        for w in self.workers:
+            event = w.swap_weights(params)
+            event['t'] = round(self.clock(), 3)
+            if tag is not None:
+                event['tag'] = tag
+            self.swap_events.append(event)
+            events.append(event)
+        return events
+
+    def swap_from_checkpoint(self, directory: str,
+                             step: Optional[int] = None) -> List[dict]:
+        """Hot-reload the latest (or a named) training checkpoint into
+        every replica — params-only restore off the async-checkpoint
+        path, then the rolling swap."""
+        from ..training.checkpoint import CheckpointManager
+        params = CheckpointManager(directory).restore_params(step)
+        tag = f'{directory}@{step if step is not None else "latest"}'
+        return self.swap_weights(params, tag=tag)

@@ -1,0 +1,123 @@
+"""Cross-replica SLO aggregation into the existing `serve` record.
+
+One record shape for one-replica and N-replica serving: both emitters
+extend `inference.telemetry.ServeTelemetryBase` (compile-delta
+accumulation, bucket windows, requests section, latency drain), so the
+PR 2 `serve` record keeps its required fields and multi-replica runs
+fold in only the aggregation fields the router adds — per-replica depth
+(`replicas`), rolling swap events (`swaps`), and the continuous-
+batching proof counter (`continuous_admissions`). Consumers that only
+understand single-replica records keep working; `obs_report --require
+serve` gates the extended ones.
+
+Aggregate per-bucket percentiles come from ONE PhaseTimer shared by
+every replica's engine (the constructor enforces it): each `run()`
+lands its device latency in the same `bucket_<L>` phase regardless of
+replica, so the record's `buckets` section is the cross-replica SLO
+surface directly — no percentile-merging approximations. Per-replica
+skew is visible separately via `replicas[i].depth` / `.served`.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..inference.admission import AdmissionController
+from ..inference.stats import agg_stats, window_stats
+from ..inference.telemetry import ServeTelemetryBase
+from ..observability import MetricLogger, RetraceWatchdog
+from .router import Router
+
+
+class RouterTelemetry(ServeTelemetryBase):
+    """Wire a router (+ admission) into the JSONL telemetry stream.
+
+        tele = RouterTelemetry(router, admission, logger)
+        tele.arm()              # AFTER every replica's warmup
+        ... serve ...
+        tele.flush()            # one extended `serve` record
+        tele.close()            # cumulative `summary` record
+        assert tele.post_warmup_compiles == 0
+    """
+
+    def __init__(self, router: Router,
+                 admission: Optional[AdmissionController] = None,
+                 logger: Optional[MetricLogger] = None,
+                 watchdog: Optional[RetraceWatchdog] = None):
+        timers = {id(w.engine.timer) for w in router.workers}
+        assert len(timers) == 1, \
+            'every replica engine must share ONE PhaseTimer (pass ' \
+            'timer=... to each InferenceEngine) — aggregate percentiles ' \
+            'cannot be merged from per-replica reservoirs'
+        super().__init__(router.workers[0].engine.timer, admission,
+                         logger, watchdog)
+        self.router = router
+        for w in router.workers:
+            for key, executable in w.engine.executables.items():
+                self.watchdog.track(f'r{w.id}_bucket_{key[0]}', executable)
+
+    def _pop_completed(self):
+        return self.router.pop_completed()
+
+    def _emit_cost_records(self):
+        """Each replica's per-bucket cost ledger, replica-tagged, so
+        capacity planning reads memory-per-bucket-per-replica off the
+        record stream."""
+        for w in self.router.workers:
+            for key in sorted(w.engine.cost_payloads):
+                body = dict(w.engine.cost_payloads[key])
+                body['label'] = f'replica_{w.id},' + body['label']
+                self.logger.log_record('cost', mirror=False, **body)
+
+    def _router_sections(self) -> dict:
+        """The aggregation fields the router adds to both records."""
+        router = self.router
+        return dict(
+            replicas={str(w.id): w.snapshot() for w in router.workers},
+            swaps=dict(count=len(router.swap_events),
+                       events=list(router.swap_events)),
+            continuous_admissions=router.continuous_admissions,
+            deadline_flushes=router.deadline_flushes,
+        )
+
+    def flush(self) -> dict:
+        """One extended `serve` record: aggregate per-bucket window
+        percentiles, request counters, per-replica depth, swap events,
+        and the continuous-admission counter."""
+        router = self.router
+        runtime = self._check_runtime()
+        fields = dict(
+            requests=self._requests_section(
+                sum(w.served_rows for w in router.workers)),
+            buckets=self._bucket_windows(router.buckets),
+            queue_depth=router.queue_depth,
+            runtime=runtime,
+            post_warmup_compiles=self.post_warmup_compiles,
+            **self._router_sections(),
+        )
+        latencies = self._drain_latencies()
+        if latencies:
+            fields['request_latency_ms'] = window_stats(latencies)
+        return self._emit('serve', fields)
+
+    def close(self) -> dict:
+        """Cumulative `summary` record across the fleet."""
+        self._check_runtime()
+        self._drain_latencies()
+        router = self.router
+        fields = dict(
+            steps=router.batches_dispatched,
+            metrics=dict(request_latency_ms=agg_stats(self._latency_agg)),
+            timing=self.timer.cumulative_summary(),
+            replicas={str(w.id): dict(w.snapshot(),
+                                      engine=w.engine.stats())
+                      for w in router.workers},
+            swaps=dict(count=len(router.swap_events),
+                       events=list(router.swap_events)),
+            continuous_admissions=router.continuous_admissions,
+            deadline_flushes=router.deadline_flushes,
+            post_warmup_compiles=self.post_warmup_compiles,
+            retrace_warnings_total=self.watchdog.warnings_total,
+        )
+        if self.admission is not None:
+            fields['requests'] = self.admission.snapshot()
+        return self._emit('summary', fields)

@@ -111,6 +111,13 @@ _HEAVY_TESTS = {
     'test_train_pipelined_telemetry_stream_valid',
     'test_train_pipelined_stops_on_source_exhaustion',
     'test_save_async_roundtrip_bit_exact',
+    # serving tier (PR 8): the sharded-engine parity guards compile two
+    # AOT bucket executables (replicated + tp-sharded) on the 8-device
+    # mesh; the router/batcher tests above them use fakes and stay fast
+    'test_sharded_engine_params_actually_partitioned',
+    'test_sharded_matches_replicated_outputs',
+    'test_sharded_padded_matches_unpadded_single_request',
+    'test_sharded_engine_zero_post_warmup_compiles_across_swap',
 }
 
 

@@ -1,0 +1,212 @@
+"""Partition-rule engine unit tests (parallel.rules): first-match-wins
+semantics, the unmatched-leaf audit, built-in tp/fsdp sets on a
+multi-axis mesh, divisibility demotion, and the sharding.py thin-caller
+contract. Pure spec math on synthetic trees — no model init, no
+compiles."""
+import warnings
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from se3_transformer_tpu.parallel import make_mesh
+from se3_transformer_tpu.parallel.rules import (
+    RULE_SETS, fsdp_rules, match_partition_rules, place_with_rules,
+    replicated_rules, resolve_rules, tp_rules,
+)
+
+
+def _model_like_tree():
+    """Synthetic param tree with the repo's real leaf names/shapes:
+    radial final weights (both layouts), attention projections, norms,
+    and a scalar."""
+    return {
+        'layers_0': {
+            'to_q': {'w1': np.zeros((8, 8), np.float32)},
+            'to_out': {'w1': np.zeros((8, 8), np.float32),
+                       'b1': np.zeros((8,), np.float32)},
+            'w3': np.zeros((16, 12, 8), np.float32),        # per-pair
+            'w3_0_1': np.zeros((16, 12, 8), np.float32),    # group layout
+            'b3': np.zeros((12, 8), np.float32),
+            'norm': {'g': np.zeros((8,), np.float32)},
+            'scalar': np.float32(1.0),
+        },
+    }
+
+
+def _flat(specs):
+    return {jax.tree_util.keystr(path): spec for path, spec in
+            jax.tree_util.tree_flatten_with_path(
+                specs, is_leaf=lambda x: isinstance(x, P))[0]}
+
+
+# --------------------------------------------------------------------- #
+# core semantics
+# --------------------------------------------------------------------- #
+def test_first_match_wins():
+    params = {'a': {'b': np.zeros((4, 4))}, 'b': np.zeros((4, 4))}
+    rules = [
+        (r'a/b$', P('tp', None)),     # specific rule first
+        (r'b$', P(None, 'tp')),       # would also match 'a/b'
+        (r'.*', P()),
+    ]
+    specs = match_partition_rules(rules, params)
+    assert specs['a']['b'] == P('tp', None)     # first match, not second
+    assert specs['b'] == P(None, 'tp')
+
+
+def test_rank_guard_falls_through_to_next_rule():
+    """A rank-guarded rule that name-matches but rank-mismatches must
+    NOT consume the leaf — scanning continues (the old ad-hoc code's
+    ndim checks, preserved as fall-through)."""
+    params = {'w3': np.zeros((6, 4))}            # rank 2, not 3
+    rules = [
+        (r'w3$', P(None, None, 'tp'), 3),
+        (r'.*', P()),
+    ]
+    specs = match_partition_rules(rules, params)
+    assert specs['w3'] == P()
+
+
+def test_unmatched_leaf_audit_is_loud_by_default():
+    params = {'covered': np.zeros((4,)), 'orphan': np.zeros((4, 4))}
+    rules = [(r'covered$', P())]
+    with pytest.raises(ValueError, match='orphan'):
+        match_partition_rules(rules, params)
+    # opt-outs: warn lists the paths, replicate stays silent
+    with pytest.warns(UserWarning, match='orphan'):
+        specs = match_partition_rules(rules, params, on_unmatched='warn')
+    assert specs['orphan'] == P()
+    with warnings.catch_warnings():
+        warnings.simplefilter('error')
+        specs = match_partition_rules(rules, params,
+                                      on_unmatched='replicate')
+    assert specs['orphan'] == P()
+
+
+def test_scalars_never_consume_a_rule():
+    params = {'s': np.float32(2.0), 'one': np.zeros((1,))}
+    # no rule matches anything — but scalars must not trip the audit
+    specs = match_partition_rules([(r'nothing', P('tp'))], params)
+    assert specs['s'] == P() and specs['one'] == P()
+
+
+def test_unknown_mesh_axis_is_an_error_not_a_fallback():
+    mesh = make_mesh(dp=4, sp=2, tp=1)
+    with pytest.raises(ValueError, match='fsdp'):
+        match_partition_rules([(r'.*', P('fsdp'))],
+                              {'w': np.zeros((4, 4))}, mesh=mesh)
+
+
+# --------------------------------------------------------------------- #
+# mesh audit: divisibility demotion, size-1 drop
+# --------------------------------------------------------------------- #
+def test_indivisible_dim_demotes_with_summary_warning():
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    params = {'odd': np.zeros((7, 4)), 'even': np.zeros((8, 4))}
+    with pytest.warns(UserWarning, match='demoted'):
+        specs = match_partition_rules(fsdp_rules(axis='dp'), params,
+                                      mesh=mesh)
+    assert specs['odd'] == P(None)        # 7 % 2 != 0 -> replicated
+    assert specs['even'] == P('dp')
+
+
+def test_size_one_axis_drops_silently():
+    mesh = make_mesh(dp=4, sp=2, tp=1)    # tp axis exists, size 1
+    params = {'w3': np.zeros((16, 12, 8), np.float32)}
+    with warnings.catch_warnings():
+        warnings.simplefilter('error')    # no demotion warning expected
+        specs = match_partition_rules(tp_rules(), params, mesh=mesh)
+    assert specs['w3'] == P(None, None, None)
+
+
+# --------------------------------------------------------------------- #
+# built-in rule sets on a multi-axis mesh
+# --------------------------------------------------------------------- #
+def test_tp_and_fsdp_specs_on_two_axis_mesh():
+    """The built-in sets produce the documented layouts over a 2-axis
+    (dp x tp) mesh: tp shards radial output channels / attention heads
+    column-wise and out-projections row-wise; fsdp shards dim 0 of
+    every divisible leaf over dp."""
+    from jax.sharding import Mesh
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ('dp', 'tp'))
+    params = _model_like_tree()
+
+    tp = _flat(match_partition_rules(tp_rules(), params, mesh=mesh))
+    assert tp["['layers_0']['w3']"] == P(None, None, 'tp')
+    assert tp["['layers_0']['w3_0_1']"] == P(None, None, 'tp')
+    assert tp["['layers_0']['b3']"] == P(None, 'tp')
+    assert tp["['layers_0']['to_q']['w1']"] == P(None, 'tp')
+    assert tp["['layers_0']['to_out']['w1']"] == P('tp', None)
+    assert tp["['layers_0']['to_out']['b1']"] == P()
+    assert tp["['layers_0']['norm']['g']"] == P()
+    assert tp["['layers_0']['scalar']"] == P()
+
+    fsdp = _flat(match_partition_rules(fsdp_rules(), params, mesh=mesh))
+    assert fsdp["['layers_0']['w3']"] == P('dp')
+    assert fsdp["['layers_0']['to_q']['w1']"] == P('dp')
+    assert fsdp["['layers_0']['norm']['g']"] == P('dp')
+    assert fsdp["['layers_0']['scalar']"] == P()
+
+    repl = _flat(match_partition_rules(replicated_rules(), params,
+                                       mesh=mesh))
+    assert all(s == P() for s in repl.values())
+
+
+def test_resolve_rules_names_and_passthrough():
+    assert set(RULE_SETS) == {'replicated', 'tp', 'fsdp'}
+    assert resolve_rules('tp') == tp_rules()
+    assert resolve_rules('fsdp', axis='sp') == fsdp_rules(axis='sp')
+    explicit = ((r'.*', P()),)
+    assert resolve_rules(explicit) == explicit
+    with pytest.raises(KeyError, match='megatron'):
+        resolve_rules('megatron')
+    # axis= on an explicit list is a config error, never a silent drop
+    with pytest.raises(ValueError, match='NAMED rule set'):
+        resolve_rules(explicit, axis='tp')
+
+
+def test_axis_forwards_to_named_rule_set():
+    """Regression: param_partition_specs(..., axis=..., rules='fsdp')
+    used to silently shard over fsdp's default dp axis instead of the
+    requested one."""
+    from se3_transformer_tpu.parallel import param_partition_specs
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    params = {'w': np.zeros((8, 4), np.float32)}
+    specs = param_partition_specs(params, mesh, axis='sp', rules='fsdp')
+    assert specs['w'] == P('sp')
+    # default still follows the set's own axis
+    assert param_partition_specs(params, mesh, rules='fsdp')['w'] == P('dp')
+
+
+# --------------------------------------------------------------------- #
+# the sharding.py thin callers + placement
+# --------------------------------------------------------------------- #
+def test_param_partition_specs_is_a_thin_caller_of_the_rule_engine():
+    """The old ad-hoc rule body is gone: param_partition_specs must
+    produce exactly what the rule engine produces, including the
+    rules= override."""
+    from se3_transformer_tpu.parallel import param_partition_specs
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    params = _model_like_tree()
+    via_caller = _flat(param_partition_specs(params, mesh))
+    via_engine = _flat(match_partition_rules(tp_rules(), params,
+                                             mesh=mesh))
+    assert via_caller == via_engine
+    via_fsdp = _flat(param_partition_specs(params, mesh, rules='fsdp'))
+    assert via_fsdp["['layers_0']['w3']"] == P('dp')
+
+
+def test_place_with_rules_places_and_returns_specs():
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    params = {'w3': np.arange(16 * 12 * 8, dtype=np.float32)
+              .reshape(16, 12, 8)}
+    placed, specs = place_with_rules(params, mesh, 'tp')
+    assert specs['w3'] == P(None, None, 'tp')
+    leaf = placed['w3']
+    assert 'tp' in str(leaf.sharding.spec)
+    # each tp shard holds half the output-channel axis
+    shard_shapes = {s.data.shape for s in leaf.addressable_shards}
+    assert all(sh[2] == 4 for sh in shard_shapes)
+    np.testing.assert_array_equal(np.asarray(leaf), params['w3'])

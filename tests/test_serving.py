@@ -1,0 +1,384 @@
+"""Multi-replica serving tests (se3_transformer_tpu.serving): the
+continuous batcher's in-flight-slot semantics (deterministic clock, fake
+runner — no compiles, no sleeps), least-outstanding dispatch, rolling
+drain-then-swap with zero dropped requests, the extended `serve` record
+schema, and the bit-exactness guards for the SHARDED engine path
+(sharded-vs-replicated and padded-vs-unpadded parity <= 1e-5 — TP
+sharding must never silently change served outputs)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from se3_transformer_tpu.inference import (
+    AdmissionController, InferenceEngine, RequestRejected,
+)
+from se3_transformer_tpu.inference.batching import PendingResult
+from se3_transformer_tpu.observability.schema import (
+    SchemaError, validate_record,
+)
+from se3_transformer_tpu.serving import (
+    ContinuousBatcher, ReplicaWorker, Router, RouterTelemetry,
+)
+
+
+class _FakeEngine:
+    """Engine-shaped stand-in: records calls and the params version in
+    effect at each dispatch (swap evidence), answers row indices."""
+
+    def __init__(self, buckets=(4, 8), batch_size=2):
+        self.buckets = tuple(buckets)
+        self.batch_size = batch_size
+        self.rows_served = {b: 0 for b in self.buckets}
+        self.calls = []
+        self._params = 'v0'
+        from se3_transformer_tpu.observability import PhaseTimer
+        self.timer = PhaseTimer()
+        self.executables = {}
+        self.cost_payloads = {}
+
+    @property
+    def params(self):
+        return self._params
+
+    @params.setter
+    def params(self, value):
+        self._params = value
+
+    def run(self, bucket, tokens, coords, mask):
+        self.calls.append((bucket, self._params))
+        self.rows_served[bucket] += int(np.asarray(mask).any(-1).sum())
+        with self.timer.phase(f'bucket_{bucket}'):
+            pass
+        return np.broadcast_to(
+            np.arange(tokens.shape[1], dtype=np.float32)[None, :, None],
+            tokens.shape + (3,))
+
+    def stats(self):
+        return dict(buckets=list(self.buckets),
+                    batch_size=self.batch_size)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _request(rng, length):
+    return (rng.randint(0, 8, size=length),
+            rng.normal(size=(length, 3)).astype(np.float32))
+
+
+def _router(n=2, buckets=(4, 8), batch_size=2, max_wait_ms=10.0,
+            max_queue_depth=None):
+    from se3_transformer_tpu.observability import PhaseTimer
+    clock = _Clock()
+    timer = PhaseTimer()    # replicas share ONE timer (telemetry contract)
+    engines = [_FakeEngine(buckets, batch_size) for _ in range(n)]
+    for e in engines:
+        e.timer = timer
+    workers = [ReplicaWorker(i, e, max_wait_ms=max_wait_ms, clock=clock)
+               for i, e in enumerate(engines)]
+    ctl = AdmissionController(max_len=max(buckets),
+                              max_queue_depth=max_queue_depth)
+    return Router(workers, admission=ctl, clock=clock), engines, clock, ctl
+
+
+# --------------------------------------------------------------------- #
+# continuous batching: in-flight admission, dispatch-on-fill
+# --------------------------------------------------------------------- #
+def test_full_slot_dispatches_inside_admit_without_deadline():
+    """The no-flush-barrier contract: a slot that fills dispatches
+    inside admit — no pump, no clock movement, and the deadline-flush
+    counter stays zero."""
+    clock = _Clock()
+    engine = _FakeEngine(buckets=(8,), batch_size=3)
+    cb = ContinuousBatcher(engine.run, engine.buckets, 3,
+                           max_wait_ms=1e9, clock=clock)
+    rng = np.random.RandomState(0)
+    ps = [PendingResult(i, n, 8, clock()) for i, n in enumerate((3, 5, 8))]
+    cb.admit(8, *_request(rng, 3), ps[0])
+    assert not ps[0].done and cb.depth == 1
+    cb.admit(8, *_request(rng, 5), ps[1])
+    assert cb.continuous_admissions == 1      # joined an in-flight slot
+    assert not ps[1].done
+    cb.admit(8, *_request(rng, 8), ps[2])     # fills -> dispatches NOW
+    assert all(p.done for p in ps)
+    assert cb.continuous_admissions == 2
+    assert cb.deadline_flushes == 0 and cb.batches_dispatched == 1
+    assert cb.depth == 0
+    # results sliced to true lengths
+    assert ps[0].result.shape == (3, 3)
+    np.testing.assert_array_equal(ps[0].result[:, 0], [0, 1, 2])
+
+
+def test_deadline_is_only_a_fallback_for_unfilled_slots():
+    clock = _Clock()
+    engine = _FakeEngine(buckets=(4, 8), batch_size=3)
+    cb = ContinuousBatcher(engine.run, engine.buckets, 3,
+                           max_wait_ms=10.0, clock=clock)
+    rng = np.random.RandomState(0)
+    p = PendingResult(0, 3, 4, clock())
+    cb.admit(4, *_request(rng, 3), p)
+    assert cb.flush_due() == 0 and not p.done      # inside the window
+    assert cb.next_deadline() == pytest.approx(0.010)
+    clock.t += 0.011
+    assert cb.flush_due() == 1 and p.done          # fallback fired
+    assert cb.deadline_flushes == 1
+    assert cb.next_deadline() is None
+
+
+def test_runner_failure_resolves_every_request_with_the_error():
+    class _Boom(Exception):
+        pass
+
+    def exploding(bucket, tokens, coords, mask):
+        raise _Boom('device OOM')
+
+    clock = _Clock()
+    cb = ContinuousBatcher(exploding, (8,), 2, max_wait_ms=1e9,
+                           clock=clock)
+    rng = np.random.RandomState(0)
+    p1 = PendingResult(0, 3, 8, clock())
+    p2 = PendingResult(1, 4, 8, clock())
+    cb.admit(8, *_request(rng, 3), p1)
+    with pytest.raises(_Boom):
+        cb.admit(8, *_request(rng, 4), p2)
+    assert p1.done and not p1.ok and isinstance(p1.error, _Boom)
+    assert cb.depth == 0
+    assert len(cb.pop_completed()) == 2
+
+
+# --------------------------------------------------------------------- #
+# router: least-outstanding placement, shedding, drain
+# --------------------------------------------------------------------- #
+def test_least_outstanding_dispatch():
+    router, engines, clock, _ = _router(n=2, batch_size=3)
+    rng = np.random.RandomState(0)
+    r1 = router.submit(*_request(rng, 3))
+    assert router.workers[0].outstanding == 1    # tie breaks to id 0
+    router.submit(*_request(rng, 3))
+    assert router.workers[1].outstanding == 1    # least outstanding
+    # preload replica 0 so it is strictly more loaded
+    router.workers[0].admit(8, *_request(rng, 6),
+                            PendingResult(99, 6, 8, clock()))
+    assert router.workers[0].outstanding == 2
+    router.submit(*_request(rng, 5))
+    assert router.workers[1].outstanding == 2    # routed to the lighter
+    assert not r1.done                           # nothing dispatched yet
+    assert router.queue_depth == 4
+
+
+def test_router_rejects_oversize_and_overload_structurally():
+    router, _, clock, ctl = _router(n=2, batch_size=4, max_queue_depth=2)
+    rng = np.random.RandomState(0)
+    with pytest.raises(RequestRejected) as e:
+        router.submit(*_request(rng, 9))         # no bucket fits
+    assert e.value.code == 'oversize'
+    router.submit(*_request(rng, 3))
+    router.submit(*_request(rng, 3))
+    with pytest.raises(RequestRejected) as e:
+        router.submit(*_request(rng, 3))         # depth at threshold
+    assert e.value.code == 'overloaded'
+    assert ctl.snapshot() == dict(
+        admitted=2, rejected=dict(oversize=1, overloaded=1))
+    assert router.drain() >= 1                   # backlog clears
+    router.submit(*_request(rng, 3))             # admission resumes
+    assert ctl.admitted == 3
+
+
+def test_drain_then_swap_drops_nothing_and_recompiles_nothing():
+    """The rolling-swap contract: everything admitted before the swap
+    answers under the old weights, the fleet re-points one replica at a
+    time, and requests submitted after the swap answer under the new
+    weights — zero dropped either side."""
+    router, engines, clock, _ = _router(n=2, batch_size=3)
+    rng = np.random.RandomState(0)
+    before = [router.submit(*_request(rng, n)) for n in (3, 3, 6)]
+    assert router.queue_depth == 3               # partial slots in flight
+    events = router.swap_weights('v1', tag='ckpt@7')
+    assert [e['replica'] for e in events] == [0, 1]
+    assert all(p.done and p.ok for p in before)  # drained, not dropped
+    assert all(v == 'v0' for _, v in
+               engines[0].calls + engines[1].calls)   # old weights answered
+    assert all(e.params == 'v1' for e in engines)
+    assert router.swap_events == events
+    pre_counts = [len(e.calls) for e in engines]
+    after = [router.submit(*_request(rng, 3)) for _ in range(6)]
+    router.drain()
+    assert all(p.done and p.ok for p in after)
+    post_swap = [call for e, n in zip(engines, pre_counts)
+                 for call in e.calls[n:]]
+    assert post_swap and all(v == 'v1' for _, v in post_swap)
+
+
+def test_single_replica_router_degenerates_to_its_batcher():
+    router, engines, clock, _ = _router(n=1, batch_size=2)
+    rng = np.random.RandomState(0)
+    p1 = router.submit(*_request(rng, 3))
+    p2 = router.submit(*_request(rng, 4))
+    assert p1.done and p2.done                   # filled -> dispatched
+    assert router.continuous_admissions == 1
+
+
+# --------------------------------------------------------------------- #
+# telemetry: the extended serve record
+# --------------------------------------------------------------------- #
+def test_router_telemetry_emits_extended_serve_record():
+    router, engines, clock, ctl = _router(n=2, batch_size=2)
+    tele = RouterTelemetry(router, ctl)
+    tele.arm()
+    rng = np.random.RandomState(0)
+    for n in (3, 3, 6, 6, 2):
+        router.submit(*_request(rng, n))
+    router.swap_weights('v1')
+    router.drain()
+    rec = tele.flush()
+    assert rec['post_warmup_compiles'] == 0
+    assert rec['continuous_admissions'] == router.continuous_admissions
+    assert rec['continuous_admissions'] >= 1
+    assert set(rec['replicas']) == {'0', '1'}
+    for snap in rec['replicas'].values():
+        assert {'depth', 'served', 'swaps'} <= set(snap)
+    assert rec['swaps']['count'] == 2
+    assert rec['requests']['served'] == 5
+    assert rec['request_latency_ms']['count'] == 5
+    validate_record(dict(rec, kind='serve', run_id='t'))
+    summary = tele.close()
+    assert summary['continuous_admissions'] == rec['continuous_admissions']
+    assert summary['metrics']['request_latency_ms']['count'] == 5
+
+
+def test_router_telemetry_requires_shared_timer():
+    engines = [_FakeEngine(), _FakeEngine()]     # two separate timers
+    workers = [ReplicaWorker(i, e) for i, e in enumerate(engines)]
+    with pytest.raises(AssertionError, match='PhaseTimer'):
+        RouterTelemetry(Router(workers))
+
+
+def test_serve_schema_validates_extension_fields():
+    base = dict(kind='serve', run_id='r',
+                requests=dict(served=3, rejected={}),
+                buckets={}, runtime=dict(compile_events_delta=0),
+                queue_depth=0, post_warmup_compiles=0)
+    validate_record(dict(base, continuous_admissions=4,
+                         replicas={'0': dict(depth=0)},
+                         swaps=dict(count=1, events=[{'replica': 0}])))
+    with pytest.raises(SchemaError, match='continuous_admissions'):
+        validate_record(dict(base, continuous_admissions=-1))
+    with pytest.raises(SchemaError, match='depth'):
+        validate_record(dict(base, replicas={'0': dict(served=1)}))
+    with pytest.raises(SchemaError, match='swaps'):
+        validate_record(dict(base, swaps=dict(count=1)))
+
+
+# --------------------------------------------------------------------- #
+# bit-exactness guards: the sharded engine path (real model, 8-dev mesh)
+# --------------------------------------------------------------------- #
+BUCKET, BATCH = 6, 2
+
+
+def _tiny_module():
+    from se3_transformer_tpu.training.denoise import DenoiseConfig
+    return DenoiseConfig(num_tokens=8, dim=4, dim_head=4, heads=1,
+                         depth=1, num_degrees=2,
+                         max_sparse_neighbors=4).build_module()
+
+
+@pytest.fixture(scope='module')
+def engine_pair():
+    """One replicated and one tp-sharded engine over identical params
+    (single bucket to keep the two AOT compiles cheap)."""
+    from se3_transformer_tpu.native.loader import chain_adjacency
+    from se3_transformer_tpu.parallel import make_mesh
+    module = _tiny_module()
+    rng = np.random.RandomState(0)
+    L = BUCKET
+    params = module.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(rng.randint(0, 8, size=(1, L))),
+        jnp.asarray(rng.normal(size=(1, L, 3)).astype(np.float32)),
+        mask=jnp.ones((1, L), bool),
+        adj_mat=jnp.asarray(chain_adjacency(L)),
+        return_type=1)['params']
+    replicated = InferenceEngine(module, params, buckets=(BUCKET,),
+                                 batch_size=BATCH, return_type=1)
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    sharded = InferenceEngine(module, params, buckets=(BUCKET,),
+                              batch_size=BATCH, return_type=1,
+                              mesh=mesh, partition_rules='tp')
+    return replicated, sharded
+
+
+def test_sharded_engine_params_actually_partitioned(engine_pair):
+    _, sharded = engine_pair
+    stats = sharded.stats()['sharding']
+    assert stats['mesh'] == dict(dp=2, sp=2, tp=2)
+    assert stats['rules'] == 'tp'
+    assert stats['sharded_params'] >= 4, stats
+    n_tp = sum(1 for leaf in jax.tree_util.tree_leaves(sharded.params)
+               if 'tp' in str(getattr(leaf.sharding, 'spec', '')))
+    assert n_tp >= 4, f'only {n_tp} param leaves tp-sharded on device'
+
+
+def test_sharded_matches_replicated_outputs(engine_pair):
+    """The acceptance criterion: TP sharding must never silently change
+    served outputs (parity <= 1e-5 on every real row)."""
+    replicated, sharded = engine_pair
+    rng = np.random.RandomState(2)
+    for length in (3, BUCKET):
+        tokens = rng.randint(0, 8, size=length)
+        coords = rng.normal(size=(length, 3)).astype(np.float32)
+        a = replicated.predict(tokens, coords)
+        b = sharded.predict(tokens, coords)
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_padded_matches_unpadded_single_request(engine_pair):
+    """Padded-vs-unpadded parity on the SHARDED path: a request padded
+    into its bucket (plus dummy batch rows) answers what the unpadded
+    model answers on the real rows."""
+    from se3_transformer_tpu.native.loader import chain_adjacency
+    _, sharded = engine_pair
+    rng = np.random.RandomState(3)
+    length = 4
+    tokens = rng.randint(0, 8, size=length)
+    coords = rng.normal(size=(length, 3)).astype(np.float32)
+    padded = sharded.predict(tokens, coords)
+    assert padded.shape == (length, 3)
+    ref = sharded.module.apply(
+        {'params': jax.device_get(sharded.params)},
+        jnp.asarray(tokens[None]), jnp.asarray(coords[None]),
+        mask=jnp.ones((1, length), bool),
+        adj_mat=jnp.asarray(chain_adjacency(length)), return_type=1)
+    np.testing.assert_allclose(padded, np.asarray(ref)[0],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_engine_zero_post_warmup_compiles_across_swap(engine_pair):
+    """A weight swap on the sharded engine re-places into the SAME
+    NamedShardings and compiles nothing; outputs change with the new
+    weights (the swap is real)."""
+    from se3_transformer_tpu.observability import RetraceWatchdog
+    _, sharded = engine_pair
+    rng = np.random.RandomState(4)
+    tokens = rng.randint(0, 8, size=5)
+    coords = rng.normal(size=(5, 3)).astype(np.float32)
+    before = sharded.predict(tokens, coords)
+    old_params = jax.device_get(sharded.params)
+    new_params = jax.tree_util.tree_map(lambda a: a * 1.5, old_params)
+    watchdog = RetraceWatchdog()
+    watchdog.check()                          # arm
+    sharded.params = new_params               # the hot swap
+    after = sharded.predict(tokens, coords)
+    delta = watchdog.check()
+    assert delta['compile_events_delta'] == 0
+    assert np.abs(after - before).max() > 0   # new weights answered
+    n_tp = sum(1 for leaf in jax.tree_util.tree_leaves(sharded.params)
+               if 'tp' in str(getattr(leaf.sharding, 'spec', '')))
+    assert n_tp >= 4                          # still partitioned

@@ -9,7 +9,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from scipy.special import sph_harm_y
+
+try:
+    from scipy.special import sph_harm_y
+except ImportError:
+    # scipy < 1.15 has no sph_harm_y; its sph_harm(m, n, theta, phi)
+    # computes the same complex harmonic with the ARGUMENT CONVENTION
+    # SWAPPED (theta = azimuth, phi = polar), so the shim just reorders
+    from scipy.special import sph_harm as _sph_harm
+
+    def sph_harm_y(n, m, theta, phi):
+        return _sph_harm(m, n, phi, theta)
 
 from se3_transformer_tpu.so3 import (
     angles_to_xyz, real_spherical_harmonics, spherical_harmonics_angles,

@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke perf-gate clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke perf-gate clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -59,6 +59,12 @@ profile-smoke:     ## toy trace -> per-scope device-time attribution (docs/PERFO
 	rm -f /tmp/profile_smoke.jsonl
 	python scripts/profile_smoke.py --metrics /tmp/profile_smoke.jsonl --min-coverage 0.8
 	python scripts/obs_report.py /tmp/profile_smoke.jsonl --validate --require cost,profile --out /tmp/profile_smoke_summary.json
+
+so2-smoke:         ## CPU so2-backend gate (docs/PERFORMANCE.md "Higher degrees via SO(2) reduction"): dense-vs-so2 parity + so2 equivariance at the swept degrees, schema'd so2_sweep A/B record, judged by the committed degree-4 perf budgets
+	rm -f /tmp/so2_smoke.jsonl
+	python scripts/so2_smoke.py --metrics /tmp/so2_smoke.jsonl
+	python scripts/obs_report.py /tmp/so2_smoke.jsonl --validate --require so2_sweep --out /tmp/so2_smoke_summary.json
+	python scripts/perf_gate.py /tmp/so2_smoke.jsonl
 
 perf-gate:         ## committed budgets vs the evidence streams (docs/PERFORMANCE.md "The perf gate"): must PASS on the current tree, then must FIRE on an injected synthetic regression
 	python scripts/perf_gate.py --fresh-cost /tmp/perf_gate_cost.jsonl

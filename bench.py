@@ -761,7 +761,127 @@ def ring_main(n_devices: int, per_device_nodes: int = None):
     return record
 
 
+def degrees_main(degrees, dense_max: int = 4, steps: int = 5):
+    """`python bench.py --degrees 2,4,6`: per-degree so2-vs-dense A/B on
+    the CPU toy bench (the ROADMAP item 2 acceptance harness).
+
+    For each max degree d, builds the SAME conv-weighted toy model (two
+    preconv layers + one attention block, tied k/v — the conv
+    contraction is the term the backends differ on) twice — dense CG
+    backend and the so2 banded backend, IDENTICAL parameters — and
+    times the jitted forward, best-of-two windows of `steps` fixed-batch
+    applies each. The dense arm runs only at degrees <= `dense_max`
+    (default 4): the dense basis at degree 6 needs the full degree-6
+    Q_J intertwiners, whose one-time host Sylvester solves take tens of
+    minutes on a cold cache — exactly the cost class the so2 backend
+    exists to avoid (its canonical blocks ship as a committed seed).
+
+    Prints ONE bench-shaped JSON line whose value is the so2 arm's
+    nodes*steps/s at the highest swept degree; the per-degree payload
+    (`degrees`: dense/so2 step ms, dense_vs_so2 ratio, so2 equivariance
+    L2, dense-vs-so2 parity where dense ran) is what scripts/
+    so2_smoke.py wraps into the schema'd `so2_sweep` record and what
+    the committed perf budgets judge (PERF_BUDGETS.json:
+    so2_degree4_beats_dense / so2_degree4_throughput_floor). Never
+    compared against the RECORD anchors: different program."""
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+    import numpy as np
+
+    from se3_transformer_tpu.models.se3_transformer import (
+        SE3TransformerModule,
+    )
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    from se3_transformer_tpu.utils.validation import equivariance_l2
+
+    enable_compilation_cache()
+    n, k, dim = 128, 12, 8
+    rng = np.random.RandomState(0)
+    feats = jnp.asarray(rng.normal(size=(1, n, dim)), jnp.float32)
+    coors = jnp.asarray(np.cumsum(rng.normal(size=(1, n, 3)), axis=1),
+                        jnp.float32)
+    mask = jnp.ones((1, n), bool)
+
+    def bench_forward(mod, params):
+        fwd = jax.jit(lambda c: mod.apply({'params': params}, feats, c,
+                                          mask=mask, return_type=1))
+        out = fwd(coors)
+        out.block_until_ready()                       # warmup compile
+        best = None
+        for _ in range(2):
+            t0 = time.monotonic()
+            for _ in range(steps):
+                out = fwd(coors)
+            out.block_until_ready()
+            dt = (time.monotonic() - t0) / steps
+            best = dt if best is None or dt < best else best
+        return best
+
+    per_degree = {}
+    for d in degrees:
+        kw = dict(dim=dim, depth=1, num_degrees=d + 1, output_degrees=2,
+                  reduce_dim_out=True, attend_self=True, num_neighbors=k,
+                  heads=2, dim_head=8, num_conv_layers=2,
+                  tie_key_values=True)
+        so2_mod = SE3TransformerModule(conv_backend='so2', **kw)
+        # init through the so2 module: identical param tree, and at
+        # degrees > dense_max it never touches the dense basis' Q_J
+        params = jax.jit(so2_mod.init,
+                         static_argnames=('return_type',))(
+            jax.random.PRNGKey(0), feats, coors, mask=mask,
+            return_type=1)['params']
+        so2_s = bench_forward(so2_mod, params)
+        entry = dict(
+            so2_step_ms=round(so2_s * 1e3, 2),
+            so2_nodes_steps_per_sec=round(n / so2_s, 2),
+            equivariance_l2_so2=equivariance_l2(so2_mod, params, feats,
+                                                coors, mask))
+        if d <= dense_max:
+            dense_mod = SE3TransformerModule(**kw)
+            out_d = dense_mod.apply({'params': params}, feats, coors,
+                                    mask=mask, return_type=1)
+            out_s = so2_mod.apply({'params': params}, feats, coors,
+                                  mask=mask, return_type=1)
+            entry['parity_l2'] = float(jnp.abs(out_d - out_s).max())
+            dense_s = bench_forward(dense_mod, params)
+            entry['dense_step_ms'] = round(dense_s * 1e3, 2)
+            entry['dense_vs_so2'] = round(dense_s / so2_s, 3)
+        per_degree[str(d)] = entry
+        print(f'degree {d}: {entry}', file=sys.stderr)
+
+    top = str(max(degrees))
+    record = {
+        'metric': f'so2_degree_sweep(dim={dim},n={n},k={k},ncl=2,'
+                  f'degrees={",".join(str(d) for d in degrees)},'
+                  f'backend=cpu)',
+        'value': per_degree[top]['so2_nodes_steps_per_sec'],
+        'unit': 'nodes*steps/sec/cpu-host',
+        'vs_baseline': 1.0,     # own-program A/B; anchors don't apply
+        'mode': 'so2_sweep',
+        'timing': 'best-of-2',
+        'degrees': per_degree,
+    }
+    if os.environ.get('SE3_TPU_CODE_REV'):
+        record['code_rev'] = os.environ['SE3_TPU_CODE_REV']
+    print(json.dumps(record))
+    return record
+
+
 if __name__ == '__main__':
+    if '--degrees' in sys.argv[1:]:
+        # CPU A/B harness (no device probe, like --ring): per-degree
+        # so2-vs-dense comparison, flags parsed before jax initializes
+        _i = sys.argv.index('--degrees')
+        _degs = [int(x) for x in sys.argv[_i + 1].split(',')] \
+            if len(sys.argv) > _i + 1 else [2, 4]
+        _dm = 4
+        if '--dense-max' in sys.argv[1:]:
+            _dm = int(sys.argv[sys.argv.index('--dense-max') + 1])
+        degrees_main(_degs, dense_max=_dm)
+        sys.exit(0)
     if '--ring' in sys.argv[1:]:
         # CPU-mesh harness: no device probe (the TPU tunnel is a single
         # chip — the sp story needs virtual devices), flags parsed before

@@ -196,9 +196,43 @@ def _gate_serve(records):
     return True
 
 
+def _gate_so2_sweep(records):
+    sweeps = [r for r in records if r.get('kind') == 'so2_sweep']
+    if not sweeps:
+        print('SO2 GATE: no so2_sweep records in the stream (was '
+              'scripts/so2_smoke.py / bench.py --degrees run?)',
+              file=sys.stderr)
+        return False
+    last = sweeps[-1]
+    degrees = last.get('degrees') or {}
+    bad_eq = [d for d, e in degrees.items()
+              if not isinstance(e.get('equivariance_l2_so2'),
+                                (int, float))
+              or e['equivariance_l2_so2'] >= 1e-4]
+    if bad_eq:
+        print(f'SO2 GATE: so2 equivariance L2 >= 1e-4 (or missing) at '
+              f'degree(s) {sorted(bad_eq)} — the reduced contraction '
+              f'broke equivariance', file=sys.stderr)
+        return False
+    ab = {d: e['dense_vs_so2'] for d, e in degrees.items()
+          if 'dense_vs_so2' in e}
+    if not ab:
+        print('SO2 GATE: no degree carries a dense arm — the sweep '
+              'proves equivariance but no A/B (the perf budgets need '
+              'dense_vs_so2)', file=sys.stderr)
+        return False
+    print(f'so2 gate ok: degrees {sorted(degrees)}, dense_vs_so2 '
+          f'{ab}, worst eq '
+          f'{max(e["equivariance_l2_so2"] for e in degrees.values()):.2e}'
+          f' (the win itself is enforced by scripts/perf_gate.py)',
+          file=sys.stderr)
+    return True
+
+
 _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       tune=_gate_tune, cost=_gate_cost,
-                      profile=_gate_profile, serve=_gate_serve)
+                      profile=_gate_profile, serve=_gate_serve,
+                      so2_sweep=_gate_so2_sweep)
 
 
 def main(argv=None):

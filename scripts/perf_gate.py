@@ -60,9 +60,11 @@ sys.path.insert(0, REPO)
 DEFAULT_BUDGETS = os.path.join(REPO, 'PERF_BUDGETS.json')
 # SERVE_MULTI.jsonl: the banked `make serve-multi-smoke` stream, so the
 # serving budgets (zero post-warmup compiles, router latency ceiling,
-# continuous-admission proof bit) are judged by a plain `make perf-gate`
+# continuous-admission proof bit) are judged by a plain `make perf-gate`.
+# SO2_SWEEP.jsonl: the banked `make so2-smoke` degree-sweep stream, so
+# the so2-vs-dense degree-4 win + throughput floor are judged too.
 DEFAULT_RECORDS = ('BENCH_r05.json', 'WIDTH_TABLE.jsonl',
-                   'SERVE_MULTI.jsonl')
+                   'SERVE_MULTI.jsonl', 'SO2_SWEEP.jsonl')
 
 
 # --------------------------------------------------------------------- #

@@ -80,6 +80,11 @@ def parse_args(argv=None):
                     help='multi-replica only: after this many submitted '
                          'requests, hot-swap fresh weights with a '
                          'rolling drain (zero recompiles, zero drops)')
+    ap.add_argument('--async-dispatch', action='store_true',
+                    help='multi-replica only: per-replica thread-pool '
+                         'dispatch — replica executions overlap instead '
+                         'of serializing through the submit loop '
+                         '(serving.ReplicaWorker async_dispatch)')
     return ap.parse_args(argv)
 
 
@@ -277,7 +282,8 @@ def serve_multi(args):
           f'{len(engines[0].executables)} bucket executables in '
           f'{time.perf_counter() - t0:.1f}s')
 
-    workers = [ReplicaWorker(i, e, max_wait_ms=args.max_wait_ms)
+    workers = [ReplicaWorker(i, e, max_wait_ms=args.max_wait_ms,
+                             async_dispatch=args.async_dispatch)
                for i, e in enumerate(engines)]
     admission = AdmissionController(max_len=buckets[-1],
                                     max_queue_depth=args.max_queue_depth)
@@ -332,7 +338,14 @@ def serve_multi(args):
         wait = router.next_deadline()
         if wait:
             time.sleep(wait)
+        elif args.async_dispatch:
+            # async mode: queue_depth includes executor-inflight rows
+            # that no deadline governs — yield instead of spinning
+            time.sleep(0.001)
         router.pump()
+    # barrier on any async dispatches and shut the executors down
+    # (no-op for synchronous replicas)
+    router.close()
     telemetry.flush()
     summary = telemetry.close()
     logger.close()

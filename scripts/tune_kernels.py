@@ -91,7 +91,9 @@ def _build_step(args):
     if args.smoke:
         # interpret-mode toy: same program shape as the CPU liveness
         # bench, with the Pallas kernels forced through the interpreter
-        # so the pick functions actually resolve on CPU
+        # so the pick functions actually resolve on CPU.
+        # --conv-backend so2 traces the banded SO(2) path instead, so
+        # the 'so2' kind's streaming chunks become tuning targets
         num_nodes, dim = args.nodes or 32, 8
         module = SE3TransformerModule(
             num_tokens=24, dim=dim, dim_head=8, heads=2, depth=1,
@@ -99,8 +101,9 @@ def _build_step(args):
             output_degrees=2, reduce_dim_out=True,
             differentiable_coors=True, num_neighbors=8,
             pallas=True, pallas_interpret=True,
-            fuse_basis=args.fuse_basis)
-        label = f'smoke,dim={dim},interpret'
+            fuse_basis=args.fuse_basis,
+            conv_backend=args.conv_backend)
+        label = f'smoke,dim={dim},interpret,{args.conv_backend}'
     else:
         num_nodes = args.nodes or 1024
         module = recipes.RECIPES[args.recipe](
@@ -202,7 +205,11 @@ def main(argv=None):
     ap.add_argument('--dim', type=int, default=64)
     ap.add_argument('--nodes', type=int, default=0)
     ap.add_argument('--kinds', nargs='+',
-                    default=['plain', 'bx', 'bxf', 'attention'])
+                    default=['plain', 'bx', 'bxf', 'attention', 'so2'])
+    ap.add_argument('--conv-backend', default='dense',
+                    help="smoke module's conv backend ('dense'|'so2');"
+                         " 'so2' makes the banded contraction's chunk "
+                         "count a tuning target")
     ap.add_argument('--max-candidates', type=int, default=0,
                     help='per target; 0 = all admissible')
     ap.add_argument('--max-targets', type=int, default=0,

@@ -53,8 +53,11 @@ CACHE_VERSION = 1
 # kernel kinds with tunable picks. 'plain'/'bx'/'bxf' are the pairwise
 # forward kernels (the backward ALWAYS runs its own bwd-model heuristic
 # — overrides and table entries never reach it, see _pick_blocks);
-# 'attention' is the fused attention forward block_n.
-KINDS = ('plain', 'bx', 'bxf', 'attention')
+# 'attention' is the fused attention forward block_n; 'so2' is the
+# banded SO(2) contraction's node-axis streaming chunk count
+# (so2/contract.py::_pick_so2_chunks — blocks = (chunks,), 1 =
+# unchunked).
+KINDS = ('plain', 'bx', 'bxf', 'attention', 'so2')
 
 # Mosaic's scoped-vmem stack limit is ~16 MiB; 12 MiB leaves slack for
 # compiler temporaries (same constant, same hard-won reason, as
@@ -432,6 +435,14 @@ def admissible_candidates(kind: str, shape: Sequence[int]
         for bn in (512, 256, 128, 64, 32, 16, 8):
             if bn <= cap and bn * row_bwd <= _VMEM_LIMIT:
                 out.append((bn,))
+    elif kind == 'so2':
+        # node-axis streaming chunk count for the banded SO(2)
+        # contraction (so2/contract.py): 1 = unchunked (the heuristic
+        # default — its working set is small), higher counts trade
+        # overhead for a lax.map memory ceiling. Always legal when the
+        # count does not exceed the node axis.
+        n = int(shape[0])
+        out = [(c,) for c in (1, 2, 4, 8) if c <= n]
     else:
         raise ValueError(f'unknown kernel kind {kind!r} (known: {KINDS})')
     return out

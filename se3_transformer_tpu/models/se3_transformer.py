@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ..basis import get_basis
-from ..ops.conv import ConvSE3
+from ..ops.conv import BackendSpec, ConvSE3, resolve_conv_backend
 from ..ops.trunk import SequentialTrunk
 from ..ops.core import LinearSE3, NormSE3
 from ..ops.egnn import EGnnNetwork
@@ -113,6 +113,17 @@ class SE3TransformerModule(nn.Module):
     egnn_feedforward: bool = False
     hidden_fiber_dict: Optional[Dict[int, int]] = None
     out_fiber_dict: Optional[Dict[int, int]] = None
+    # contraction backend per conv layer (ops.conv.CONV_BACKENDS):
+    # 'dense' (default — the CG tensor product) or 'so2' (the banded
+    # SO(2) reduction, se3_transformer_tpu.so2 — the higher-degree
+    # path), applied to every ConvSE3; or first-match-wins
+    # (layer-name regex, backend) pairs to MIX backends per layer,
+    # e.g. (('to_[vk]', 'so2'), ('.*', 'dense')). Layer names:
+    # 'conv_in', 'preconv{i}', 'attn_block{i}/to_v',
+    # 'attn_block{i}/to_k', 'conv_out'. Dense basis tensors are built
+    # only for layers that need them; so2 edge frames likewise — an
+    # all-so2 model never pays the O(P*Q*F) per-edge basis at all.
+    conv_backend: BackendSpec = 'dense'
     # None -> auto (Pallas fused pairwise kernel on TPU, XLA elsewhere)
     pallas: Optional[bool] = None
     # contract the angular basis inside the pairwise kernel (forward):
@@ -174,6 +185,15 @@ class SE3TransformerModule(nn.Module):
                 object.__setattr__(
                     self, field,
                     tuple(sorted((int(d), int(c)) for d, c in val.items())))
+        # per-layer backend rules may arrive as {pattern: backend} or a
+        # list of pairs — normalize to a hashable tuple of pairs
+        # (ORDER-PRESERVING: first match wins, so never sort)
+        cb = self.conv_backend
+        if not isinstance(cb, (str, tuple)):
+            items = cb.items() if hasattr(cb, 'items') else cb
+            object.__setattr__(
+                self, 'conv_backend',
+                tuple((str(p), str(b)) for p, b in items))
         super().__post_init__()
 
     # ------------------------------------------------------------------ #
@@ -512,25 +532,56 @@ class SE3TransformerModule(nn.Module):
                                                    noise_full)
             return adj_mat, adj_ind_full, sp_full, num_sparse
 
+    def _layer_backends(self, fiber_out):
+        """Resolve the conv_backend spec per conv layer (first-match-wins
+        on the layer name — ops.conv.resolve_conv_backend). The dict
+        drives which per-edge payloads _body builds: dense basis tensors
+        only when a layer consumes them, so2 edge frames likewise."""
+        names = ['conv_in']
+        names += [f'preconv{i}' for i in range(self.num_conv_layers)]
+        if not self.use_egnn:
+            for i in range(self.depth):
+                names.append(f'attn_block{i}/to_v')
+                if not (self.linear_proj_keys or self.tie_key_values):
+                    names.append(f'attn_block{i}/to_k')
+        if fiber_out is not None:
+            names.append('conv_out')
+        return {n: resolve_conv_backend(self.conv_backend, n)
+                for n in names}
+
     def _body(self, feats, hood, edges, mask, global_feats, return_type,
               return_pooled, num_degrees, fiber_in, fiber_hidden, fiber_out,
               b, n):
         # rotary embeddings (reference :1298-1325)
         pos_emb = self._rotary_embeddings(b, n, hood)
 
+        backends = self._layer_backends(fiber_out)
+        need_dense = 'dense' in backends.values()
+        extra_backends = sorted(set(backends.values()) - {'dense'})
+
         # basis, in-trace (reference :1329). The fused bx kernel path
         # takes the flat (p,f,q) layout: one padded minor axis (~1.1x)
         # instead of the structured form's (Q,F)->(8,128) tile pad (up
         # to ~60x HBM inflation at num_degrees=4); the convs unflatten
         # automatically if dispatch resolves away from the kernel.
+        # Non-dense backends get their payload under their reserved key
+        # instead — an all-so2 model skips the CG basis entirely (at
+        # degree 6 that is 49 per-edge [P, Q, F] tensors never built).
         from ..ops.conv import _use_pallas
         layout = 'pfq_flat' if (
             self.fuse_basis
             and _use_pallas(self.pallas, self.pallas_interpret)) else 'pqf'
+        basis = {}
         with named_scope('basis'):
-            basis = get_basis(hood.rel_pos, num_degrees - 1,
-                              differentiable=self.differentiable_coors,
-                              layout=layout)
+            if need_dense:
+                basis = get_basis(hood.rel_pos, num_degrees - 1,
+                                  differentiable=self.differentiable_coors,
+                                  layout=layout)
+            if 'so2' in extra_backends:
+                from ..so2.frames import edge_frames
+                basis['so2'] = edge_frames(
+                    hood.rel_pos, num_degrees - 1,
+                    differentiable=self.differentiable_coors)
 
         edge_info = (hood.indices, hood.mask, edges)
         x = feats
@@ -550,22 +601,26 @@ class SE3TransformerModule(nn.Module):
         # project in + pre-convs (reference :1338-1344)
         with named_scope('conv_in'):
             x = ConvSE3(fiber_in, fiber_hidden, name='conv_in',
+                        backend=backends['conv_in'],
                         **conv_kwargs)(x, edge_info, hood.rel_dist, basis)
         for i in range(self.num_conv_layers):
             x = NormSE3(fiber_hidden, gated_scale=self.norm_gated_scale,
                         name=f'preconv_norm{i}')(x)
             x = ConvSE3(fiber_hidden, fiber_hidden, name=f'preconv{i}',
+                        backend=backends[f'preconv{i}'],
                         **conv_kwargs)(x, edge_info, hood.rel_dist, basis)
 
         # trunk (reference :1096-1109, :1348)
         with named_scope('trunk'):
             x = self._trunk(x, fiber_hidden, edge_info, hood.rel_dist,
-                            basis, global_feats, pos_emb, mask, conv_kwargs)
+                            basis, global_feats, pos_emb, mask, conv_kwargs,
+                            backends)
 
         # project out (reference :1352-1363)
         if fiber_out is not None:
             with named_scope('conv_out'):
                 x = ConvSE3(fiber_hidden, fiber_out, name='conv_out',
+                            backend=backends['conv_out'],
                             **conv_kwargs)(x, edge_info, hood.rel_dist,
                                            basis)
 
@@ -636,7 +691,8 @@ class SE3TransformerModule(nn.Module):
         return (query_pos_emb, key_pos_emb)
 
     def _trunk(self, x, fiber_hidden, edge_info, rel_dist, basis,
-               global_feats, pos_emb, mask, conv_kwargs):
+               global_feats, pos_emb, mask, conv_kwargs, backends=None):
+        backends = backends or {}
         if self.use_egnn:
             # the EGNN trunk has no ConvSE3 tags — a policy here would be
             # a silent no-op claimed by the config
@@ -655,9 +711,16 @@ class SE3TransformerModule(nn.Module):
         assert not (self.reversible and self.accept_global_feats), \
             'reversibility and global features are not compatible'
 
+        value_backends = tuple(
+            backends.get(f'attn_block{i}/to_v', 'dense')
+            for i in range(self.depth))
+        key_backends = tuple(
+            backends.get(f'attn_block{i}/to_k', 'dense')
+            for i in range(self.depth))
         return SequentialTrunk(
             fiber_hidden, depth=self.depth, heads=self.heads,
             dim_head=self.dim_head, attend_self=self.attend_self,
+            value_backends=value_backends, key_backends=key_backends,
             edge_dim=conv_kwargs['edge_dim'],
             use_null_kv=self.use_null_kv,
             fourier_encode_dist=self.fourier_encode_dist,

@@ -72,6 +72,14 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    coverage field (share of device time attributed to
                    known scopes — `make profile-smoke` gates on it);
                    optional roofline utilization vs the bf16 MXU peak.
+  so2_sweep        per-degree so2-vs-dense contraction A/B
+                   (bench.degrees_main via scripts/so2_smoke.py):
+                   label, degrees (per-max-degree {so2_step_ms,
+                   so2_nodes_steps_per_sec, equivariance_l2_so2 — the
+                   load-bearing gate field — and, where the dense arm
+                   ran, dense_step_ms + dense_vs_so2 + parity_l2}).
+                   `make so2-smoke` gates on it and PERF_BUDGETS.json
+                   enforces the degree-4 win + throughput floor.
   summary          end-of-run cumulative record (metrics, timing,
                    nodes_steps_per_sec, loss trajectory,
                    retrace_warnings_total).
@@ -88,7 +96,8 @@ from typing import Iterable, Union
 SCHEMA_VERSION = 1
 
 KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
-               'serve', 'tune', 'comm', 'cost', 'profile', 'summary')
+               'serve', 'tune', 'comm', 'cost', 'profile', 'so2_sweep',
+               'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
@@ -122,6 +131,10 @@ _REQUIRED = {
     # a profile record that cannot say how much device time its scopes
     # account for proves nothing about where the time went
     'profile': ('run_id', 'label', 'scopes', 'device_time_ms', 'coverage'),
+    # equivariance_l2_so2 per degree is the load-bearing field of the
+    # backend contract: a sweep record that cannot say the reduced
+    # contraction is still equivariant proves nothing about the speedup
+    'so2_sweep': ('run_id', 'label', 'degrees'),
     'summary': ('run_id', 'steps', 'metrics', 'timing'),
 }
 
@@ -312,6 +325,27 @@ def validate_record(rec: dict, index=None) -> dict:
             _fail(index, f'profile.device_time_ms must be a '
                          f'non-negative number, got '
                          f'{rec["device_time_ms"]!r}')
+    if kind == 'so2_sweep':
+        degrees = rec['degrees']
+        if not isinstance(degrees, dict) or not degrees:
+            _fail(index, 'so2_sweep.degrees must be a non-empty object '
+                         '(max degree -> A/B entry)')
+        for deg, entry in degrees.items():
+            if not isinstance(entry, dict):
+                _fail(index, f'degrees[{deg!r}] must be an object')
+            for field in ('so2_step_ms', 'so2_nodes_steps_per_sec',
+                          'equivariance_l2_so2'):
+                val = entry.get(field)
+                if not isinstance(val, (int, float)) or val < 0 \
+                        or isinstance(val, bool):
+                    _fail(index, f'degrees[{deg!r}].{field} must be a '
+                                 f'non-negative number, got {val!r}')
+            if 'dense_step_ms' in entry and \
+                    not isinstance(entry.get('dense_vs_so2'),
+                                   (int, float)):
+                _fail(index, f'degrees[{deg!r}] carries dense_step_ms '
+                             f'but no numeric dense_vs_so2 — the A/B '
+                             f'ratio IS the record')
     if kind in ('flush', 'summary'):
         timing = rec['timing']
         if not isinstance(timing, dict):

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 from typing import Dict, Optional
 
@@ -98,6 +99,11 @@ class PhaseTimer:
         self._window: Dict[str, list] = {}
         self._all: Dict[str, list] = {}
         self._totals: Dict[str, dict] = {}
+        # recorders and the flush reader may live on different threads
+        # (serving's async-dispatch replicas all record into ONE shared
+        # timer while the main loop flushes): the count/total
+        # read-modify-writes and the window swap must not race
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -108,30 +114,34 @@ class PhaseTimer:
             self.record(name, time.perf_counter() - t0)
 
     def record(self, name: str, seconds: float):
-        self._window.setdefault(name, []).append(seconds)
-        full = self._all.setdefault(name, [])
-        if len(full) < self.capacity:
-            full.append(seconds)
-        tot = self._totals.setdefault(
-            name, dict(count=0, total_s=0.0, max_s=0.0))
-        tot['count'] += 1
-        tot['total_s'] += seconds
-        tot['max_s'] = max(tot['max_s'], seconds)
+        with self._lock:
+            self._window.setdefault(name, []).append(seconds)
+            full = self._all.setdefault(name, [])
+            if len(full) < self.capacity:
+                full.append(seconds)
+            tot = self._totals.setdefault(
+                name, dict(count=0, total_s=0.0, max_s=0.0))
+            tot['count'] += 1
+            tot['total_s'] += seconds
+            tot['max_s'] = max(tot['max_s'], seconds)
 
     def window_summary(self, reset: bool = True) -> dict:
-        out = {name: _percentiles(samples)
-               for name, samples in self._window.items() if samples}
-        if reset:
-            self._window = {}
-        return out
+        with self._lock:
+            window = self._window
+            if reset:
+                self._window = {}
+            else:
+                window = {k: list(v) for k, v in window.items()}
+        return {name: _percentiles(samples)
+                for name, samples in window.items() if samples}
 
     def cumulative_summary(self) -> dict:
+        with self._lock:
+            snap = {name: (list(samples), dict(self._totals[name]))
+                    for name, samples in self._all.items() if samples}
         out = {}
-        for name, samples in self._all.items():
-            if not samples:
-                continue
+        for name, (samples, tot) in snap.items():
             stats = _percentiles(samples)
-            tot = self._totals[name]
             stats.update(count=tot['count'],
                          total_s=round(tot['total_s'], 4),
                          max_ms=round(tot['max_s'] * 1e3, 3))

@@ -54,6 +54,10 @@ class AttentionSE3(nn.Module):
     pallas_interpret: bool = False
     radial_bf16: bool = False
     conv_bf16: bool = False
+    # conv backends for the value/key ConvSE3 paths (ops.conv
+    # registry; resolved per layer by the model's conv_backend spec)
+    backend_v: str = 'dense'
+    backend_k: str = 'dense'
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -94,7 +98,7 @@ class AttentionSE3(nn.Module):
             queries = LinearSE3(self.fiber, hidden_fiber,
                                 name='to_q')(features)
             values = ConvSE3(self.fiber, kv_fiber, name='to_v',
-                             **conv_kwargs)(
+                             backend=self.backend_v, **conv_kwargs)(
                 features, edge_info, rel_dist, basis)
 
             if self.linear_proj_keys:
@@ -105,7 +109,7 @@ class AttentionSE3(nn.Module):
                 keys = values
             else:
                 keys = ConvSE3(self.fiber, kv_fiber, name='to_k',
-                               **conv_kwargs)(
+                               backend=self.backend_k, **conv_kwargs)(
                     features, edge_info, rel_dist, basis)
 
             if self.attend_self:
@@ -270,6 +274,8 @@ class AttentionBlockSE3(nn.Module):
     pallas_interpret: bool = False
     radial_bf16: bool = False
     conv_bf16: bool = False
+    backend_v: str = 'dense'
+    backend_k: str = 'dense'
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -283,6 +289,7 @@ class AttentionBlockSE3(nn.Module):
             out = AttentionSE3(
                 self.fiber, heads=self.heads, dim_head=self.dim_head,
                 kv_heads=1 if self.one_headed_key_values else None,
+                backend_v=self.backend_v, backend_k=self.backend_k,
                 attend_self=self.attend_self, edge_dim=self.edge_dim,
                 use_null_kv=self.use_null_kv,
                 fourier_encode_dist=self.fourier_encode_dist,

@@ -23,8 +23,9 @@ eager chunking.
 """
 from __future__ import annotations
 
+import re
 from functools import partial
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple, Union
 
 import flax.linen as nn
 import jax
@@ -42,6 +43,60 @@ EdgeInfo = Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]
 
 # radial-MLP hidden width (reference RadialFunc mid_dim, :283)
 DEFAULT_MID_DIM = 128
+
+# --------------------------------------------------------------------- #
+# contraction backend registry
+#
+# 'dense' is the in-file Clebsch-Gordan tensor-product path (basis
+# tensors from basis.get_basis, optionally fused into the Pallas
+# kernels). Alternative backends register a pairwise contract callable
+#     impl(h, w3, b3, payload, x, *, d_in, d_out, pallas,
+#          pallas_interpret, edge_chunks, conv_bf16) -> [..., c_out, P]
+# sharing the dense path's parameter layout (w3 [mid, c_in*F, c_out],
+# b3 [c_in*F, c_out]) so backends can be swapped per layer with
+# identical checkpoints. `payload` is whatever the backend's model-side
+# builder put under its reserved key in the basis dict (the so2 backend
+# stores its edge-frame harmonics under basis['so2'] —
+# so2/contract.py). Built-ins resolve lazily to avoid import cycles.
+# --------------------------------------------------------------------- #
+CONV_BACKENDS: Dict[str, Optional[Callable]] = {'dense': None}
+_LAZY_BACKENDS = {'so2': 'se3_transformer_tpu.so2.contract'}
+
+# spec: one backend name for every layer, or first-match-wins
+# (layer-name regex, backend) pairs — the parallel/rules.py idiom
+BackendSpec = Union[str, Tuple[Tuple[str, str], ...]]
+
+
+def register_conv_backend(name: str, impl: Callable) -> None:
+    """Register a pairwise-contraction backend (see the signature
+    contract above). Re-registration overwrites — latest wins."""
+    CONV_BACKENDS[name] = impl
+
+
+def get_conv_backend(name: str) -> Callable:
+    """The registered contract callable for `name` ('dense' has no
+    callable — its path is inline in PairwiseConvSE3/ConvSE3)."""
+    if name not in CONV_BACKENDS and name in _LAZY_BACKENDS:
+        import importlib
+        importlib.import_module(_LAZY_BACKENDS[name])  # self-registers
+    if name not in CONV_BACKENDS:
+        raise KeyError(
+            f'unknown conv backend {name!r} (registered: '
+            f'{sorted(set(CONV_BACKENDS) | set(_LAZY_BACKENDS))})')
+    return CONV_BACKENDS[name]
+
+
+def resolve_conv_backend(spec: BackendSpec, layer_name: str) -> str:
+    """Per-layer backend resolution: a plain string applies everywhere;
+    a tuple of (pattern, backend) pairs is matched FIRST-MATCH-WINS
+    against the layer name ('conv_in', 'preconv0', 'attn_block1/to_v',
+    'conv_out', ...) with an implicit ('.*', 'dense') tail."""
+    if isinstance(spec, str):
+        return spec
+    for pat, backend in spec:
+        if re.search(pat, layer_name):
+            return backend
+    return 'dense'
 
 
 class RadialFunc(nn.Module):
@@ -298,17 +353,55 @@ class PairwiseConvSE3(nn.Module):
     # [c_out, c_in, F] kernel tensors, reference :326-343); the numerics
     # oracle for the fused paths above. Param layout differs.
     fused: bool = True
+    # contraction backend (CONV_BACKENDS): 'dense' = the CG tensor
+    # product below; 'so2' = the banded SO(2) reduction (so2/contract).
+    # Non-dense backends share the fused parameter layout (w3/b3), so
+    # the SAME checkpoint serves either backend.
+    backend: str = 'dense'
+    # so2 only, set by ConvSE3: the caller already rotated x into the
+    # edge frame (shared across this layer's degree pairs) and will
+    # rotate the accumulated per-degree output back itself — this
+    # module then computes only the banded + radial middle. Rotations
+    # are parameter-free, so the param tree is identical either way.
+    so2_edge_frame_io: bool = False
 
     @nn.compact
     def __call__(self, edge_feats: jnp.ndarray, basis_slice: jnp.ndarray,
                  x: jnp.ndarray) -> jnp.ndarray:
-        """edge_feats [b,n,k,e]; basis_slice [b,n,k,P,Q,F]; x [b,n,k,c_in,Q]
-        -> [b,n,k,c_out,P]. (With a shared radial trunk, ConvSE3 fuses all
-        pairs of an output degree itself and never calls this module.)"""
+        """edge_feats [b,n,k,e]; basis_slice [b,n,k,P,Q,F] (dense) or the
+        backend's payload (e.g. the so2 edge-frame dict); x
+        [b,n,k,c_in,Q] -> [b,n,k,c_out,P]. (With a shared radial trunk,
+        ConvSE3 fuses all pairs of an output degree itself and never
+        calls this module.)"""
         F = to_order(min(self.degree_in, self.degree_out))
         P = to_order(self.degree_out)
         Q = to_order(self.degree_in)
         IF = self.nc_in * F
+
+        if self.backend != 'dense':
+            impl = get_conv_backend(self.backend)
+            assert self.fused, \
+                f'backend {self.backend!r} requires the fused ' \
+                f'parameterization (fused=False is the dense-path oracle)'
+            h = radial_hidden(
+                edge_feats, self.mid_dim,
+                dtype=jnp.bfloat16 if self.radial_bf16 else None)
+            w3 = self.param(
+                'w3',
+                nn.initializers.variance_scaling(
+                    1.0, 'fan_in', 'truncated_normal',
+                    in_axis=0, out_axis=(1, 2)),
+                (h.shape[-1], IF, self.nc_out), jnp.float32)
+            b3 = self.param('b3', nn.initializers.zeros,
+                            (IF, self.nc_out), jnp.float32)
+            extra = dict(edge_frame_io=True) if self.so2_edge_frame_io \
+                else {}
+            return impl(h, w3, b3, basis_slice, x,
+                        d_in=self.degree_in, d_out=self.degree_out,
+                        pallas=self.pallas,
+                        pallas_interpret=self.pallas_interpret,
+                        edge_chunks=self.edge_chunks,
+                        conv_bf16=self.conv_bf16, **extra)
 
         use_bx = self.fuse_basis and _use_pallas(self.pallas,
                                                  self.pallas_interpret)
@@ -498,6 +591,30 @@ class ConvSE3(nn.Module):
     fuse_basis: bool = False
     radial_bf16: bool = False
     conv_bf16: bool = False
+    # contraction backend for every degree pair of this layer
+    # (CONV_BACKENDS; per-layer selection happens in the model via
+    # resolve_conv_backend). Non-dense backends read their payload from
+    # the basis dict's reserved key (e.g. basis['so2']) and share the
+    # dense path's parameter layout.
+    backend: str = 'dense'
+
+    def _grouped_pair_params(self, degree_in: int, degree_out: int,
+                             mid: int, m_in: int, m_out: int):
+        """The shared-trunk grouped (w3, b3) for one degree pair — ONE
+        definition for the dense and so2 grouped branches, because the
+        'one checkpoint serves any backend mix' guarantee is exactly
+        these names/shapes/initializers staying identical."""
+        F = to_order(min(degree_in, degree_out))
+        IF = m_in * F
+        w3 = self.param(
+            f'w3_{degree_in}_{degree_out}',
+            nn.initializers.variance_scaling(1.0, 'fan_in',
+                                             'truncated_normal',
+                                             in_axis=0, out_axis=(1, 2)),
+            (mid, IF, m_out), jnp.float32)
+        b3 = self.param(f'b3_{degree_in}_{degree_out}',
+                        nn.initializers.zeros, (IF, m_out), jnp.float32)
+        return w3, b3
 
     @nn.compact
     def __call__(self, inp: Features, edge_info: EdgeInfo,
@@ -531,10 +648,72 @@ class ConvSE3(nn.Module):
 
         fuse_bx = self.fuse_basis and _use_pallas(self.pallas,
                                                   self.pallas_interpret)
+        backend_impl = get_conv_backend(self.backend) \
+            if self.backend != 'dense' else None
+        so2_hoist = self.backend == 'so2'
+        if so2_hoist:
+            # rotation hoisting: rotate every input degree into the
+            # edge frame ONCE (shared across all (d_in, d_out) pairs of
+            # this layer) and rotate each output degree back once after
+            # summing over input degrees. Rotations are parameter-free,
+            # so the param tree matches the unhoisted path exactly; the
+            # per-pair modules below run banded+radial only
+            # (so2_edge_frame_io). Without this a degree-6 layer redoes
+            # the Wigner application 49x instead of 13x — measured as
+            # most of the so2 step on the toy sweep.
+            from ..so2.contract import banded_z
+            from ..so2.frames import rotate_in, rotate_out
+            so2_frames = basis[self.backend]
+            rotated = {str(di): rotate_in(gathered[str(di)],
+                                          so2_frames, di)
+                       for di, _ in self.fiber_in}
 
         outputs = {}
         for degree_out, m_out in self.fiber_out:
-            if self.shared_radial_hidden:
+            if so2_hoist and self.shared_radial_hidden:
+                # grouped so2: the edge-frame z segments share the P
+                # axis and concatenate along the contracted IF axis
+                # exactly like the dense path's v2 segments — ONE fused
+                # radial contraction per output degree (same grouped
+                # w3_{d_in}_{d_out} params as dense grouped mode)
+                z_segs, w3s, b3s = [], [], []
+                for degree_in, m_in in self.fiber_in:
+                    w3, b3 = self._grouped_pair_params(
+                        degree_in, degree_out, hidden.shape[-1], m_in,
+                        m_out)
+                    w3s.append(w3)
+                    b3s.append(b3)
+                    z_segs.append(banded_z(rotated[str(degree_in)],
+                                           degree_in, degree_out))
+                acc = _radial_contract(
+                    hidden, jnp.concatenate(w3s, axis=1),
+                    jnp.concatenate(b3s, axis=0),
+                    jnp.concatenate(z_segs, axis=-1),
+                    pallas=self.pallas,
+                    pallas_interpret=self.pallas_interpret,
+                    edge_chunks=self.edge_chunks,
+                    conv_bf16=self.conv_bf16)            # [..., P, O]
+                acc = rotate_out(jnp.swapaxes(acc, -1, -2), so2_frames,
+                                 degree_out)             # [..., O, P]
+            elif so2_hoist:
+                acc = None
+                for degree_in, m_in in self.fiber_in:
+                    y = PairwiseConvSE3(
+                        degree_in, m_in, degree_out, m_out,
+                        pallas=self.pallas,
+                        pallas_interpret=self.pallas_interpret,
+                        edge_chunks=self.edge_chunks,
+                        fuse_basis=self.fuse_basis,
+                        radial_bf16=self.radial_bf16,
+                        conv_bf16=self.conv_bf16,
+                        backend=self.backend,
+                        so2_edge_frame_io=True,
+                        name=f'pair_{degree_in}_{degree_out}')(
+                            edge_features, so2_frames,
+                            rotated[str(degree_in)])     # [..., O, P]
+                    acc = y if acc is None else acc + y
+                acc = rotate_out(acc, so2_frames, degree_out)
+            elif self.shared_radial_hidden:
                 # the shared trunk makes every (d_in -> d_out) pair differ
                 # only in (w3, b3, v2), all concatenable along the
                 # contracted IF axis: ONE fused contraction (one Pallas
@@ -549,15 +728,9 @@ class ConvSE3(nn.Module):
                     P = to_order(degree_out)
                     Q = to_order(degree_in)
                     IF = m_in * F
-                    w3 = self.param(
-                        f'w3_{degree_in}_{degree_out}',
-                        nn.initializers.variance_scaling(
-                            1.0, 'fan_in', 'truncated_normal',
-                            in_axis=0, out_axis=(1, 2)),
-                        (hidden.shape[-1], IF, m_out), jnp.float32)
-                    b3 = self.param(
-                        f'b3_{degree_in}_{degree_out}',
-                        nn.initializers.zeros, (IF, m_out), jnp.float32)
+                    w3, b3 = self._grouped_pair_params(
+                        degree_in, degree_out, hidden.shape[-1], m_in,
+                        m_out)
                     basis_pair = basis[f'{degree_in},{degree_out}']
                     if fuse_bx:
                         y = _radial_contract_bx(
@@ -589,6 +762,9 @@ class ConvSE3(nn.Module):
             else:
                 acc = None
                 for degree_in, m_in in self.fiber_in:
+                    basis_slice = basis[self.backend] \
+                        if backend_impl is not None \
+                        else basis[f'{degree_in},{degree_out}']
                     y = PairwiseConvSE3(
                         degree_in, m_in, degree_out, m_out,
                         pallas=self.pallas,
@@ -597,9 +773,10 @@ class ConvSE3(nn.Module):
                         fuse_basis=self.fuse_basis,
                         radial_bf16=self.radial_bf16,
                         conv_bf16=self.conv_bf16,
+                        backend=self.backend,
                         name=f'pair_{degree_in}_{degree_out}')(
                             edge_features,
-                            basis[f'{degree_in},{degree_out}'],
+                            basis_slice,
                             gathered[str(degree_in)])
                     acc = y if acc is None else acc + y
 

@@ -79,6 +79,11 @@ class SequentialTrunk(nn.Module):
     pallas_interpret: bool = False
     radial_bf16: bool = False
     conv_bf16: bool = False
+    # per-block conv backends for the attention value/key ConvSE3 paths
+    # (resolved by the model from its conv_backend spec; None = dense
+    # everywhere — ops.conv.CONV_BACKENDS)
+    value_backends: Optional[tuple] = None
+    key_backends: Optional[tuple] = None
 
     @nn.compact
     def __call__(self, x: Features, edge_info, rel_dist, basis,
@@ -99,6 +104,10 @@ class SequentialTrunk(nn.Module):
         for i in range(self.depth):
             x = attn_cls(
                 self.fiber, heads=self.heads, dim_head=self.dim_head,
+                backend_v=(self.value_backends[i]
+                           if self.value_backends else 'dense'),
+                backend_k=(self.key_backends[i]
+                           if self.key_backends else 'dense'),
                 attend_self=self.attend_self, edge_dim=self.edge_dim,
                 use_null_kv=self.use_null_kv,
                 fourier_encode_dist=self.fourier_encode_dist,

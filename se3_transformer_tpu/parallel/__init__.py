@@ -6,8 +6,9 @@ from .exchange import (
     exchange_index_select, exchange_scope, neighbor_gather, rowwise_gather,
 )
 from .rules import (
-    RULE_SETS, fsdp_rules, match_partition_rules, place_with_rules,
-    replicated_rules, resolve_rules, tp_rules,
+    RULE_SETS, fsdp_rules, match_partition_rules,
+    opt_state_partition_specs, place_with_rules,
+    replicated_rules, resolve_rules, shard_opt_state, tp_rules,
 )
 from .sharding import (
     make_sharded_train_step, make_accumulating_train_step, replicated,

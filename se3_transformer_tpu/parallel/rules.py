@@ -226,6 +226,80 @@ def match_partition_rules(rules: Union[str, Rules], params,
     return specs
 
 
+def opt_state_partition_specs(rules: Union[str, Rules], params, opt_state,
+                              mesh: Optional[Mesh] = None,
+                              on_unmatched: str = 'error'):
+    """PartitionSpec pytree for an OPTIMIZER state under the same rules
+    that shard the params (the ROADMAP item 5 'true FSDP' first step:
+    `fsdp` rules previously applied to params only, leaving adam's
+    mu/nu — 2x the parameter memory — replicated on every chip).
+
+    Optimizer states mirror the param tree inside wrapper containers
+    (optax's ScaleByAdamState.mu/nu are param-structured pytrees, the
+    chain adds tuple indices), so each state leaf's path looks like
+    '0/mu/<param path>'. Resolution per leaf:
+
+      * a param whose path is a SUFFIX of the state leaf's path AND
+        whose shape matches inherits that param's AUDITED spec —
+        mu/nu shard exactly like their parameter, mesh demotions
+        included, so gather/update math stays elementwise-local;
+      * scalar leaves (adam's `count`, schedule states) replicate;
+      * anything else (a state leaf with no param twin, e.g.
+        factored-second-moment slices) falls back to matching `rules`
+        against its own path — same audit, same `on_unmatched`
+        contract as match_partition_rules.
+    """
+    param_specs = match_partition_rules(rules, params, mesh=mesh,
+                                        on_unmatched=on_unmatched)
+    # flatten side by side (identical treedefs; PartitionSpec is a
+    # tuple subclass, so the spec tree needs the explicit is_leaf)
+    flat_params = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_specs = jax.tree_util.tree_leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P))
+    by_path = {
+        path_of(kp): (tuple(getattr(leaf, 'shape', ()) or ()), spec)
+        for (kp, leaf), spec in zip(flat_params, flat_specs)}
+
+    def assign(key_path, leaf):
+        shape = tuple(getattr(leaf, 'shape', ()) or ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        parts = path_of(key_path).split('/')
+        for i in range(len(parts)):
+            hit = by_path.get('/'.join(parts[i:]))
+            if hit is not None and hit[0] == shape:
+                return hit[1]
+        # no param twin: match the rules against the leaf's OWN path —
+        # rebuilt as a nested singleton tree so name-anchored rules
+        # (e.g. tp's `(^|/)w3...`) see the same '/'-joined path they
+        # would on a param, not the empty string a bare leaf yields
+        tree = leaf
+        for part in reversed(parts):
+            tree = {part: tree}
+        spec_tree = match_partition_rules(rules, tree, mesh=mesh,
+                                          on_unmatched=on_unmatched)
+        return jax.tree_util.tree_leaves(
+            spec_tree, is_leaf=lambda x: isinstance(x, P))[0]
+
+    return jax.tree_util.tree_map_with_path(assign, opt_state)
+
+
+def shard_opt_state(opt_state, params, mesh: Mesh,
+                    rules: Union[str, Rules] = 'fsdp',
+                    axis: Optional[str] = None,
+                    on_unmatched: str = 'error'):
+    """Place an optimizer state on the mesh under the params' rule set
+    (default: the fsdp set — dim-0 sharding over dp). Returns
+    (placed_opt_state, specs)."""
+    specs = opt_state_partition_specs(resolve_rules(rules, axis), params,
+                                      opt_state, mesh=mesh,
+                                      on_unmatched=on_unmatched)
+    placed = jax.tree_util.tree_map(
+        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+        opt_state, specs)
+    return placed, specs
+
+
 def place_with_rules(params, mesh: Mesh, rules: Union[str, Rules],
                      on_unmatched: str = 'error'):
     """Match rules, then device_put every leaf into its NamedSharding.

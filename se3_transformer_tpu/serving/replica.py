@@ -21,7 +21,9 @@ partition-rule shardings).
 """
 from __future__ import annotations
 
+import threading
 import time
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -65,17 +67,31 @@ class ContinuousBatcher:
     `inference.batching.dispatch_batch` (pad / slice-to-true-rows /
     resolve-every-request-on-a-raising-runner), so the two batchers
     cannot drift.
+
+    With `executor` set (the ReplicaWorker `async_dispatch=True` path),
+    a filled slot SUBMITS its dispatch to that executor instead of
+    blocking the admit caller — on a multi-chip host, N replicas'
+    executions then overlap instead of serializing through the router's
+    submit loop. Semantics shift accordingly: a raising runner still
+    resolves every request of its batch done-with-error (that happens
+    inside dispatch_batch on the worker thread), but the exception
+    re-raises at the next `wait()` barrier (drain / swap / close)
+    rather than inside admit; `inflight` counts submitted-but-unanswered
+    requests so the router's least-outstanding signal keeps seeing work
+    the executor has not finished.
     """
 
     def __init__(self, runner: Callable, buckets: Sequence[int],
                  batch_size: int, max_wait_ms: float = 50.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 executor: Optional[ThreadPoolExecutor] = None):
         self.runner = runner
         self.buckets = tuple(sorted(int(b) for b in buckets))
         assert self.buckets, 'no buckets'
         self.batch_size = int(batch_size)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.clock = clock
+        self.executor = executor
         self._slots: Dict[int, _Slot] = {}
         self.continuous_admissions = 0   # joined an in-flight slot
         self.deadline_flushes = 0        # fallback dispatches
@@ -86,12 +102,28 @@ class ContinuousBatcher:
         # own PendingResult either way)
         self.completed: List[PendingResult] = []
         self._completed_capacity = 65536
+        # async-dispatch bookkeeping (unused on the sync path)
+        self._futures: List[Future] = []
+        self._inflight_rows = 0
+        self._inflight_lock = threading.Lock()
+        # executor threads publish into `completed` while the main
+        # thread's pop_completed swaps it — every access goes through
+        # this lock (each dispatch resolves into a private list first,
+        # so dispatch_batch itself never touches the shared one)
+        self._completed_lock = threading.Lock()
 
     # ------------------------------------------------------------------ #
     @property
     def depth(self) -> int:
         """Requests sitting in open slots (not yet dispatched)."""
         return sum(len(s) for s in self._slots.values())
+
+    @property
+    def inflight(self) -> int:
+        """Requests submitted to the executor but not yet answered
+        (always 0 on the sync path — dispatch completes inline)."""
+        with self._inflight_lock:
+            return self._inflight_rows
 
     def admit(self, bucket: int, tokens, coords,
               pending: PendingResult) -> PendingResult:
@@ -142,8 +174,26 @@ class ContinuousBatcher:
         return max(0.0, min(oldest) + self.max_wait_s - now)
 
     def pop_completed(self) -> List[PendingResult]:
-        done, self.completed = self.completed, []
+        with self._completed_lock:
+            done, self.completed = self.completed, []
         return done
+
+    def wait(self) -> None:
+        """Barrier over every async dispatch in flight; re-raises the
+        FIRST runner exception (its requests already resolved
+        done-with-error inside dispatch_batch — this surfaces the
+        failure to the serving loop the way the sync path's raising
+        admit does). No-op on the sync path."""
+        futures, self._futures = self._futures, []
+        first_err = None
+        for f in futures:
+            try:
+                f.result()
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
 
     # ------------------------------------------------------------------ #
     def _dispatch(self, slot: _Slot):
@@ -152,12 +202,45 @@ class ContinuousBatcher:
         # requests resolve done-with-error, never silently re-slotted)
         pending = slot.pending
         self._slots.pop(slot.bucket, None)
-        dispatch_batch(self.runner, slot.bucket, self.batch_size,
-                       slot.tokens, slot.coords, pending,
-                       self.completed, self._completed_capacity,
-                       self.clock)
+
+        def run():
+            # dispatch_batch resolves into a PRIVATE list; the shared
+            # `completed` is only touched under the lock — an executor
+            # thread appending into a list pop_completed just swapped
+            # out would silently lose those results from the serve
+            # record otherwise
+            done_local: List[PendingResult] = []
+            try:
+                dispatch_batch(self.runner, slot.bucket, self.batch_size,
+                               slot.tokens, slot.coords, pending,
+                               done_local, self._completed_capacity,
+                               self.clock)
+            finally:
+                with self._completed_lock:
+                    self.completed.extend(done_local)
+                    if len(self.completed) > self._completed_capacity:
+                        del self.completed[:-self._completed_capacity]
         self.batches_dispatched += 1
         self.rows_dispatched += len(pending)
+        if self.executor is None:
+            run()
+            return
+        with self._inflight_lock:
+            self._inflight_rows += len(pending)
+        # drop cleanly-finished futures so the list stays bounded
+        # without a barrier; errored ones are KEPT until wait() can
+        # re-raise them
+        self._futures = [f for f in self._futures
+                         if not f.done() or f.exception() is not None]
+
+        def tracked():
+            try:
+                run()
+            finally:
+                with self._inflight_lock:
+                    self._inflight_rows -= len(pending)
+
+        self._futures.append(self.executor.submit(tracked))
 
 
 class ReplicaWorker:
@@ -170,23 +253,45 @@ class ReplicaWorker:
     `outstanding` (requests admitted but unanswered) is the router's
     least-outstanding load signal; `draining=True` takes the replica
     out of dispatch rotation while a swap is in flight.
+
+    `async_dispatch=True` gives the replica a single-thread executor
+    and routes every slot dispatch through it: the router's submit loop
+    never blocks on an engine execution, so on a multi-chip host the N
+    replicas' executions OVERLAP instead of serializing (the PR 8
+    residue — the synchronous router was measured replica-sequential by
+    construction). One thread per replica keeps each engine's
+    executions serialized with respect to THEMSELVES (AOT executables
+    are not assumed re-entrant) while distinct replicas run
+    concurrently. `drain()` and `swap_weights()` barrier on the
+    executor, so the rolling-swap contract (old weights answer
+    everything already admitted) and the deterministic-clock test
+    semantics are unchanged; runner errors surface at those barriers
+    instead of inside admit (see ContinuousBatcher.wait).
     """
 
     def __init__(self, replica_id: int, engine, *,
                  max_wait_ms: float = 50.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 async_dispatch: bool = False):
         self.id = int(replica_id)
         self.engine = engine
+        self.executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f'replica{self.id}') \
+            if async_dispatch else None
         self.batcher = ContinuousBatcher(
             engine.run, engine.buckets, engine.batch_size,
-            max_wait_ms=max_wait_ms, clock=clock)
+            max_wait_ms=max_wait_ms, clock=clock,
+            executor=self.executor)
         self.draining = False
         self.swaps = 0
 
     # ------------------------------------------------------------------ #
     @property
     def outstanding(self) -> int:
-        return self.batcher.depth
+        # open-slot depth + async dispatches not yet answered: the
+        # least-outstanding router must keep seeing a replica's work
+        # until the executor finishes it
+        return self.batcher.depth + self.batcher.inflight
 
     @property
     def served_rows(self) -> int:
@@ -203,24 +308,35 @@ class ReplicaWorker:
         return self.batcher.flush_due(now)
 
     def drain(self) -> int:
-        return self.batcher.drain()
+        """Dispatch every partial slot AND barrier on any async
+        dispatches — after drain() returns, everything admitted has
+        answered (the end-of-stream / pre-swap contract)."""
+        n = self.batcher.drain()
+        self.batcher.wait()
+        return n
 
     def swap_weights(self, params) -> dict:
         """Drain the in-flight slots (old weights answer everything
-        already admitted), then re-point the engine at `params`. AOT
-        executables take params as a call argument, so the swap
-        compiles NOTHING — the engine's params setter re-places into
-        the same partition-rule shardings. Returns the swap event for
-        the telemetry stream."""
+        already admitted — the drain barriers on the executor, so an
+        async dispatch can never race the re-point), then re-point the
+        engine at `params`. AOT executables take params as a call
+        argument, so the swap compiles NOTHING — the engine's params
+        setter re-places into the same partition-rule shardings.
+        Returns the swap event for the telemetry stream."""
         self.draining = True
         try:
-            drained = self.batcher.drain()
+            drained = self.drain()
             self.engine.params = params
         finally:
             self.draining = False
         self.swaps += 1
         return dict(replica=self.id, drained_batches=drained,
                     swap_index=self.swaps)
+
+    def close(self) -> None:
+        """Shut the executor down (idempotent; sync replicas no-op)."""
+        if self.executor is not None:
+            self.executor.shutdown(wait=True)
 
     def snapshot(self) -> dict:
         """Per-replica depth/served/swap counters for the serve record."""

@@ -23,6 +23,15 @@ throughput with three policies, all deterministic and clock-injectable:
 Structured shedding reuses the PR 2 `AdmissionController` — oversize
 and overload rejections raise `RequestRejected` before touching any
 compiled path, counted for the serve record.
+
+Dispatch is non-blocking when the workers were built with
+`async_dispatch=True` (ReplicaWorker): a filled slot submits its
+execution to the replica's own single-thread executor, so the submit
+loop keeps admitting while engines run and N replicas' executions
+overlap on a multi-chip host. The router's verbs are unchanged —
+`drain`/`swap_weights` barrier per replica, so the rolling-swap
+zero-drop contract holds in either mode; call `close()` at end of
+stream to shut the executors down.
 """
 from __future__ import annotations
 
@@ -136,8 +145,16 @@ class Router:
 
     def drain(self) -> int:
         """Dispatch every partial slot on every replica (end of
-        stream). Returns batches dispatched."""
+        stream) and barrier on any async dispatches — when it returns,
+        everything admitted has answered. Returns batches dispatched."""
         return sum(w.drain() for w in self.workers)
+
+    def close(self) -> None:
+        """Drain, then shut down the replicas' dispatch executors
+        (no-op for synchronous replicas)."""
+        self.drain()
+        for w in self.workers:
+            w.close()
 
     def pop_completed(self) -> List[PendingResult]:
         done: List[PendingResult] = []

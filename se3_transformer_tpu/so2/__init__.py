@@ -1,0 +1,43 @@
+"""SO(2)-reduced higher-degree contraction backend (eSCN / EquiformerV2).
+
+The dense path pays the full Clebsch-Gordan tensor-product cost in every
+ConvSE3 contraction — per edge, per degree pair, a [P, Q, F] basis tensor
+contracted against the neighbor features, which explodes in the
+representation degree and is why the flagship caps max_degree low
+(ROADMAP item 2). This package implements the eSCN reduction
+(arXiv:2302.03655, adopted by EquiformerV2/V3): rotate each edge frame so
+the relative position lies on the canonical axis, whereupon the dense CG
+contraction collapses into a banded SO(2) contraction — block-diagonal in
+the azimuthal index m — then rotate back. Same outputs (the canonical
+kernels derive from the SAME Q_J intertwiners as `basis.get_basis`, so
+dense-vs-so2 parity is exact up to float roundoff), a fraction of the
+flops, and no per-edge [P, Q, F] basis tensor in HBM.
+
+Modules:
+  * `canonical` — host-side canonical-axis kernel blocks per degree pair
+    (the m-banded compression of Q_J @ Y_J(e_z)), lru-cached + persisted
+    like the basis.py Q_J pattern, with a committed seed covering
+    degrees <= 6 so nobody pays the degree-6 Sylvester solve at runtime;
+  * `frames` — traced per-edge alignment: azimuth/polar harmonics
+    (cos m*alpha, sin m*alpha, ...) straight from Cartesian components
+    (no trig calls), plus the Wigner z-rotation / J-involution
+    factorization D(alpha, beta, 0) = Dz(a) J Dz(b) J^T that applies a
+    full Wigner rotation as two banded elementwise passes and two
+    constant matmuls;
+  * `contract` — the banded contraction itself (rotate-to-axis -> per-m
+    banded multiply with the SAME learned radial weights as the dense
+    path -> radial contraction -> rotate back), registered as conv
+    backend 'so2' in `ops.conv.CONV_BACKENDS` and as kernel-tuning kind
+    'so2' in `kernels.tuning`.
+
+Select it per layer via `SE3TransformerModule(conv_backend='so2')` (or a
+first-match-wins (pattern, backend) rule list — see docs/API.md).
+"""
+from .canonical import canonical_blocks, canonical_kernel
+from .frames import edge_frames, rotate_in, rotate_out, wigner_from_frames
+from .contract import banded_z, so2_pair_contract
+
+__all__ = [
+    'banded_z', 'canonical_blocks', 'canonical_kernel', 'edge_frames',
+    'rotate_in', 'rotate_out', 'so2_pair_contract', 'wigner_from_frames',
+]

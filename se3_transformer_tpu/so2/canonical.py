@@ -1,0 +1,160 @@
+"""Canonical-axis kernel blocks: the banded heart of the SO(2) reduction.
+
+For a degree pair (d_in, d_out) and frequency J, the dense path's angular
+kernel is K_J(rhat) = reshape(Q_J @ Y_J(rhat)). At the canonical axis
+rhat = e_z the real spherical harmonics are 1-sparse (only m = 0
+survives), and the equivariance constraint under z-rotations forces the
+canonical kernel Kc_J = K_J(e_z) to be BANDED: nonzero only where
+|m_out| == |m_in|, with each m > 0 block a 2x2 rotation-like matrix
+
+    [[a, b], [-b, a]]     over the (-m, +m) index pair
+
+and a single scalar a at m = 0. (Verified to 1e-12 against the full Q_J
+construction for every pair <= degree 6 when the committed seed was
+generated; re-asserted by tests/test_so2.py.) The whole [F, P, Q] kernel
+family of a pair therefore compresses to two [F, min(d_in, d_out) + 1]
+coefficient tables (a, b) — a few hundred bytes — and the per-edge
+contraction to elementwise multiplies on the +/-m component pairs.
+
+Because the blocks derive from the SAME Q_J intertwiners (including
+basis.py's deterministic sign convention) that `get_basis` contracts on
+the dense path, the so2 backend computes the IDENTICAL function given
+identical radial weights — the dense-vs-so2 parity gate rides on this.
+
+Resolution order (the basis.py Q_J durability pattern):
+  in-memory lru  >  committed package seed (degrees <= 6)  >
+  user cache npz (CACHE_PATH)  >  compute from Q_J (and persist).
+The committed seed exists because the degree-6 Sylvester solves behind
+Q_J take minutes of host float64 SVD — the one-time cost was paid when
+the seed was generated, not by every fresh container.
+"""
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+from typing import Tuple
+
+import numpy as np
+
+from ..basis import CACHE_PATH, CLEAR_CACHE
+
+_SEED_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          '_canonical_seed.npz')
+_CACHE_VERSION = 1
+
+
+def _cache_file() -> str:
+    return os.path.join(CACHE_PATH, f'so2_canonical_v{_CACHE_VERSION}.npz')
+
+
+def _load_npz_pair(path: str, d_in: int, d_out: int):
+    try:
+        with np.load(path) as data:
+            ka, kb = f'{d_in}_{d_out}_a', f'{d_in}_{d_out}_b'
+            if ka in data and kb in data:
+                return np.array(data[ka]), np.array(data[kb])
+    except Exception:  # noqa: BLE001 - corrupt/truncated file: miss
+        return None
+    return None
+
+
+def _store_cached(d_in: int, d_out: int, a: np.ndarray, b: np.ndarray):
+    """Best-effort persist (read-modify-write under a file lock, atomic
+    rename — the basis._store_cached_qj pattern, minus its tmp-reaping
+    housekeeping: these files are tiny)."""
+    if CLEAR_CACHE or not CACHE_PATH:
+        return
+    try:
+        os.makedirs(CACHE_PATH, exist_ok=True)
+        path = _cache_file()
+        lock_path = os.path.join(CACHE_PATH, 'so2.lock')
+        with open(lock_path, 'w') as lock_fh:
+            try:
+                import fcntl
+                fcntl.flock(lock_fh, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass
+            existing = {}
+            if os.path.exists(path):
+                try:
+                    with np.load(path) as data:
+                        existing = {k: data[k] for k in data.files}
+                except Exception:  # noqa: BLE001 - rebuild from scratch
+                    existing = {}
+            existing[f'{d_in}_{d_out}_a'] = a
+            existing[f'{d_in}_{d_out}_b'] = b
+            tmp = path + f'.{os.getpid()}.tmp.npz'
+            np.savez(tmp, **existing)
+            os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def _compute_from_qj(d_in: int, d_out: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """The from-first-principles construction: contract each Q_J with
+    the 1-sparse Y_J(e_z) and read the band coefficients off the
+    resulting [P, Q] kernel — asserting the band structure really holds
+    (an off-band residual would mean the SH/Wigner conventions drifted
+    from the ones the seed was generated under)."""
+    from ..basis import basis_transformation_Q_J
+    from ..so3.spherical_harmonics import real_spherical_harmonics
+
+    P, Q = 2 * d_out + 1, 2 * d_in + 1
+    mmin = min(d_in, d_out)
+    Js = range(abs(d_in - d_out), d_in + d_out + 1)
+    ez = np.array([0., 0., 1.])
+    a = np.zeros((2 * mmin + 1, mmin + 1))
+    b = np.zeros((2 * mmin + 1, mmin + 1))
+    for f, J in enumerate(Js):
+        Qj = basis_transformation_Q_J(J, d_in, d_out)
+        Kc = (Qj @ real_spherical_harmonics(J, ez, xp=np)).reshape(P, Q)
+        for m in range(mmin + 1):
+            a[f, m] = Kc[d_out - m, d_in - m]
+            if m > 0:
+                b[f, m] = Kc[d_out - m, d_in + m]
+        recon = _reconstruct(a[f], b[f], d_in, d_out)
+        assert np.abs(recon - Kc).max() < 1e-10, (
+            f'canonical kernel for (d_in={d_in}, d_out={d_out}, J={J}) '
+            f'is not m-banded (max off-band residual '
+            f'{np.abs(recon - Kc).max():.2e}) — the SH/Wigner '
+            f'conventions no longer match the SO(2) reduction')
+    return a, b
+
+
+def _reconstruct(a_f: np.ndarray, b_f: np.ndarray, d_in: int,
+                 d_out: int) -> np.ndarray:
+    P, Q = 2 * d_out + 1, 2 * d_in + 1
+    K = np.zeros((P, Q))
+    for m in range(min(d_in, d_out) + 1):
+        K[d_out - m, d_in - m] = a_f[m]
+        K[d_out + m, d_in + m] = a_f[m]
+        if m > 0:
+            K[d_out - m, d_in + m] = b_f[m]
+            K[d_out + m, d_in - m] = -b_f[m]
+    return K
+
+
+@lru_cache(maxsize=None)
+def canonical_blocks(d_in: int, d_out: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """(a, b) coefficient tables for the pair, each [F, mmin + 1]
+    float64 with F = 2 * min(d_in, d_out) + 1 frequencies (J =
+    |d_in - d_out| .. d_in + d_out, f-major — the SAME frequency order
+    the dense basis stacks) and b[:, 0] == 0 by construction."""
+    for path in (_SEED_PATH, _cache_file()):
+        got = _load_npz_pair(path, d_in, d_out) if os.path.exists(path) \
+            else None
+        if got is not None:
+            return got
+    a, b = _compute_from_qj(d_in, d_out)
+    _store_cached(d_in, d_out, a, b)
+    return a, b
+
+
+def canonical_kernel(d_in: int, d_out: int) -> np.ndarray:
+    """Dense [F, P, Q] reconstruction of the canonical-axis kernels —
+    the reference form tests compare against get_basis(e_z)."""
+    a, b = canonical_blocks(d_in, d_out)
+    return np.stack([_reconstruct(a[f], b[f], d_in, d_out)
+                     for f in range(a.shape[0])])

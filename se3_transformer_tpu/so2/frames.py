@@ -1,0 +1,168 @@
+"""Per-edge frame alignment for the SO(2) backend (traced, trig-free).
+
+Every edge's relative position rhat factors as rhat = R(alpha, beta, 0)
+e_z (ZYZ Euler angles, the so3.wigner convention), and the Wigner
+rotation of any degree factors through the J-involution identity
+
+    D_l(R(alpha, beta, 0)) = Dz_l(alpha) @ J_l @ Dz_l(beta) @ J_l^T
+
+where Dz_l is the z-rotation representation — banded with 2x2 blocks
+[[cos m*t, sin m*t], [-sin m*t, cos m*t]] over each (-m, +m) index pair
+— and J_l = D_l(Rx(-pi/2)) is a host float64 constant per degree
+(derived from our own spherical harmonics via so3.wigner, so the
+convention can never drift; verified to 1e-15 in tests/test_so2.py).
+Applying a full Wigner rotation to features therefore costs two banded
+elementwise passes plus two constant matmuls — no per-edge [P, P]
+matrix is ever materialized.
+
+The angle harmonics themselves come straight from the Cartesian
+components, no trig calls and no pole singularities beyond the guarded
+division: with rhat = (x, y, z),
+
+    cos(beta) = z      sin(beta) = rho = sqrt(x^2 + y^2)
+    cos(alpha) = x / rho    sin(alpha) = y / rho   (rho > eps)
+
+and cos/sin of the higher harmonics m*theta follow by the 2-term
+angle-addition recursion (exactly the spherical_harmonics.py A_m/B_m
+trick). At the pole (rho <= eps: rhat parallel to e_z, including the
+zero-vector padding edges) alpha is undefined; it is pinned to 0 —
+any value yields the same rotation there, and the guarded `where`
+keeps gradients finite.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..basis import safe_normalize
+
+Frames = Dict[str, jnp.ndarray]
+
+_EPS = 1e-8
+
+
+@lru_cache(maxsize=None)
+def j_matrix(l: int) -> np.ndarray:
+    """J_l = D_l(Rx(-pi/2)), float64 host constant (cheap: one lstsq
+    over the sampled-point system in so3.wigner — no Sylvester solve,
+    so no disk cache needed)."""
+    from ..so3.wigner import wigner_d_from_rotation
+    rx = np.array([[1., 0., 0.],
+                   [0., 0., 1.],
+                   [0., -1., 0.]])  # Rx(-pi/2): y -> -z, z -> y
+    return wigner_d_from_rotation(l, rx)
+
+
+def edge_frames(rel_pos: jnp.ndarray, max_degree: int,
+                differentiable: bool = False) -> Frames:
+    """Alignment-frame harmonics for every edge.
+
+    rel_pos [..., 3] (need not be normalized) -> {'cos_a', 'sin_a',
+    'cos_b', 'sin_b': [..., max_degree + 1]} with entry m holding
+    cos/sin(m * angle). This is the ONLY per-edge payload the so2
+    backend materializes — O(L) floats per edge versus the dense
+    basis's O(P * Q * F) per degree pair.
+
+    `differentiable` mirrors get_basis: False stops coordinate
+    gradients through the frames.
+    """
+    rhat, norm = safe_normalize(rel_pos)
+    x, y, z = rhat[..., 0], rhat[..., 1], rhat[..., 2]
+    rho_sq = x * x + y * y
+    rho = jnp.sqrt(jnp.maximum(rho_sq, _EPS * _EPS))
+    on_axis = rho_sq <= _EPS * _EPS
+    cos_a = jnp.where(on_axis, 1.0, x / rho)
+    sin_a = jnp.where(on_axis, 0.0, y / rho)
+    # a degenerate edge (zero-length rel_pos: padding / self) pins to
+    # the identity rotation — rhat is the zero vector there, and
+    # (cos, sin) = (0, 0) would make Dz(beta) singular instead of a
+    # rotation (these edges are masked downstream, but the frames must
+    # stay valid rotations so roundtrips and gradients never degrade)
+    degenerate = norm <= _EPS
+    cos_b = jnp.where(degenerate, 1.0, z)
+    sin_b = jnp.where(degenerate, 0.0, jnp.sqrt(rho_sq))
+
+    out = dict(zip(('cos_a', 'sin_a'), _harmonics(cos_a, sin_a,
+                                                  max_degree)))
+    out.update(zip(('cos_b', 'sin_b'), _harmonics(cos_b, sin_b,
+                                                  max_degree)))
+    if not differentiable:
+        out = jax.tree_util.tree_map(jax.lax.stop_gradient, out)
+    return out
+
+
+def _harmonics(c1, s1, l_max: int):
+    """cos/sin(m*t) for m = 0..l_max by angle-addition recursion."""
+    cs = [jnp.ones_like(c1)]
+    sn = [jnp.zeros_like(s1)]
+    for _ in range(l_max):
+        cs.append(cs[-1] * c1 - sn[-1] * s1)
+        sn.append(sn[-1] * c1 + cs[-2] * s1)
+    return jnp.stack(cs, axis=-1), jnp.stack(sn, axis=-1)
+
+
+def _dz_apply(x: jnp.ndarray, cos_m: jnp.ndarray, sin_m: jnp.ndarray,
+              l: int, sign: float) -> jnp.ndarray:
+    """Apply Dz_l(sign * theta) over the LAST axis of x ([..., 2l+1],
+    any leading shape broadcastable from the frames' edge shape):
+
+        y[q] = cos(|m_q| t) x[q] + s_q sin(|m_q| t) x[flip(q)]
+
+    with m_q = q - l and s_q = +1 / 0 / -1 for m_q < 0 / = 0 / > 0 —
+    the [[c, s], [-s, c]] block over each (-m, +m) pair, as two
+    multiplies and a reversal instead of a [P, P] matmul."""
+    if l == 0:
+        return x
+    m_abs = np.abs(np.arange(-l, l + 1))
+    s_q = np.sign(-np.arange(-l, l + 1)).astype(np.float64)
+    cv = cos_m[..., m_abs]
+    sv = sign * sin_m[..., m_abs] * jnp.asarray(s_q, x.dtype)
+    while cv.ndim < x.ndim:
+        cv, sv = cv[..., None, :], sv[..., None, :]
+    return cv * x + sv * x[..., ::-1]
+
+
+def _matvec(M: np.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.einsum('pq,...q->...p', jnp.asarray(M, x.dtype), x)
+
+
+def rotate_in(x: jnp.ndarray, frames: Frames, l: int) -> jnp.ndarray:
+    """Features into the edge frame: D_l(R_e)^T x over the last axis.
+
+    D^T = J Dz(-beta) J^T Dz(-alpha), applied factor by factor (the two
+    Dz passes are banded elementwise, the two J contractions are
+    constant matmuls)."""
+    if l == 0:
+        return x
+    J = j_matrix(l)
+    t = _dz_apply(x, frames['cos_a'], frames['sin_a'], l, -1.0)
+    t = _matvec(J.T, t)
+    t = _dz_apply(t, frames['cos_b'], frames['sin_b'], l, -1.0)
+    return _matvec(J, t)
+
+
+def rotate_out(y: jnp.ndarray, frames: Frames, l: int) -> jnp.ndarray:
+    """Edge-frame outputs back to the lab frame: D_l(R_e) y over the
+    last axis (the exact inverse of rotate_in — D is orthogonal)."""
+    if l == 0:
+        return y
+    J = j_matrix(l)
+    t = _matvec(J.T, y)
+    t = _dz_apply(t, frames['cos_b'], frames['sin_b'], l, 1.0)
+    t = _matvec(J, t)
+    return _dz_apply(t, frames['cos_a'], frames['sin_a'], l, 1.0)
+
+
+def wigner_from_frames(frames: Frames, l: int) -> jnp.ndarray:
+    """Dense per-edge Wigner matrices D_l(R_e) [..., 2l+1, 2l+1] —
+    test/inspection reference for the factored application above (the
+    hot path never materializes these)."""
+    P = 2 * l + 1
+    shape = frames['cos_a'].shape[:-1]
+    eye = jnp.broadcast_to(jnp.eye(P), shape + (P, P))
+    return jnp.swapaxes(rotate_out(jnp.swapaxes(eye, -1, -2), frames, l),
+                        -1, -2)

@@ -227,6 +227,116 @@ def test_single_replica_router_degenerates_to_its_batcher():
 
 
 # --------------------------------------------------------------------- #
+# async dispatch: non-blocking submit, overlapping replicas
+# --------------------------------------------------------------------- #
+class _BarrierEngine(_FakeEngine):
+    """Engine whose run() parks on a shared release event after
+    signalling entry — a DETERMINISTIC overlap probe (no sleeps): two
+    replicas both inside run() at once is concurrency, proven by
+    events, not timing."""
+
+    def __init__(self, release, entered, **kw):
+        super().__init__(**kw)
+        self._release = release
+        self._entered = entered
+
+    def run(self, bucket, tokens, coords, mask):
+        self._entered.set()
+        assert self._release.wait(10.0), 'overlap barrier never released'
+        return super().run(bucket, tokens, coords, mask)
+
+
+def test_async_dispatch_overlaps_replica_executions():
+    """The PR 8 residue fix: with async_dispatch, a filled slot's
+    execution must NOT block the submit loop — two replicas' engines
+    are observed inside run() SIMULTANEOUSLY (impossible on the
+    synchronous path, where the first dispatch would block submit
+    until it returned)."""
+    import threading
+    release = threading.Event()
+    entered = [threading.Event(), threading.Event()]
+    clock = _Clock()
+    from se3_transformer_tpu.observability import PhaseTimer
+    timer = PhaseTimer()
+    engines = [_BarrierEngine(release, entered[i], buckets=(8,),
+                              batch_size=2) for i in range(2)]
+    for e in engines:
+        e.timer = timer
+    workers = [ReplicaWorker(i, e, max_wait_ms=1e9, clock=clock,
+                             async_dispatch=True)
+               for i, e in enumerate(engines)]
+    router = Router(workers, clock=clock)
+    rng = np.random.RandomState(0)
+    try:
+        ps = [router.submit(*_request(rng, 3)) for _ in range(4)]
+        # both replicas' slots filled and dispatched; submit returned
+        # while BOTH engines are still parked inside run()
+        assert entered[0].wait(10.0) and entered[1].wait(10.0)
+        assert not any(p.done for p in ps)
+        assert router.queue_depth == 4       # inflight still counts
+    finally:
+        release.set()
+    router.close()
+    assert all(p.done and p.ok for p in ps)
+    assert router.queue_depth == 0
+
+
+def test_async_dispatch_swap_contract_and_results_match_sync():
+    """Rolling swap on async replicas: the drain barrier answers
+    everything under the old weights before re-pointing (zero drops,
+    same contract as sync)."""
+    clock = _Clock()
+    from se3_transformer_tpu.observability import PhaseTimer
+    timer = PhaseTimer()
+    engines = [_FakeEngine(buckets=(4, 8), batch_size=3)
+               for _ in range(2)]
+    for e in engines:
+        e.timer = timer
+    workers = [ReplicaWorker(i, e, max_wait_ms=10.0, clock=clock,
+                             async_dispatch=True)
+               for i, e in enumerate(engines)]
+    router = Router(workers, clock=clock)
+    rng = np.random.RandomState(0)
+    before = [router.submit(*_request(rng, n)) for n in (3, 3, 6)]
+    router.swap_weights('v1')
+    assert all(p.done and p.ok for p in before)
+    assert all(v == 'v0' for _, v in
+               engines[0].calls + engines[1].calls)
+    after = [router.submit(*_request(rng, 3)) for _ in range(6)]
+    router.close()
+    assert all(p.done and p.ok for p in after)
+    assert all(e.params == 'v1' for e in engines)
+
+
+def test_async_runner_error_surfaces_at_the_barrier():
+    """A raising runner resolves its batch done-with-error on the
+    worker thread; the exception re-raises at the drain barrier (the
+    async analogue of the sync path's raising admit)."""
+    class _Boom(Exception):
+        pass
+
+    def exploding(bucket, tokens, coords, mask):
+        raise _Boom('device OOM')
+
+    from concurrent.futures import ThreadPoolExecutor
+    clock = _Clock()
+    ex = ThreadPoolExecutor(max_workers=1)
+    cb = ContinuousBatcher(exploding, (8,), 2, max_wait_ms=1e9,
+                           clock=clock, executor=ex)
+    rng = np.random.RandomState(0)
+    p1 = PendingResult(0, 3, 8, clock())
+    p2 = PendingResult(1, 4, 8, clock())
+    cb.admit(8, *_request(rng, 3), p1)       # no raise: non-blocking
+    cb.admit(8, *_request(rng, 4), p2)       # fills -> async dispatch
+    with pytest.raises(_Boom):
+        cb.wait()
+    assert p1.done and not p1.ok and isinstance(p1.error, _Boom)
+    assert p2.done and not p2.ok
+    cb.wait()                                 # errors drain exactly once
+    ex.shutdown(wait=True)
+
+
+# --------------------------------------------------------------------- #
 # telemetry: the extended serve record
 # --------------------------------------------------------------------- #
 def test_router_telemetry_emits_extended_serve_record():

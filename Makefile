@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke perf-gate clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke flash-smoke perf-gate clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -65,6 +65,12 @@ so2-smoke:         ## CPU so2-backend gate (docs/PERFORMANCE.md "Higher degrees 
 	python scripts/so2_smoke.py --metrics /tmp/so2_smoke.jsonl
 	python scripts/obs_report.py /tmp/so2_smoke.jsonl --validate --require so2_sweep --out /tmp/so2_smoke_summary.json
 	python scripts/perf_gate.py /tmp/so2_smoke.jsonl
+
+flash-smoke:       ## CPU streaming-attention gate (docs/PERFORMANCE.md "Flash equivariant attention"): dense-arm + so2-arm parity vs the unfused path (masked rows, XLA stream AND interpret-mode Pallas kernel), fused equivariance at degrees 2/4, schema'd flash A/B record, judged by the committed step-time + peak-HBM win budgets
+	rm -f /tmp/flash_smoke.jsonl
+	python scripts/flash_smoke.py --metrics /tmp/flash_smoke.jsonl
+	python scripts/obs_report.py /tmp/flash_smoke.jsonl --validate --require flash --out /tmp/flash_smoke_summary.json
+	python scripts/perf_gate.py /tmp/flash_smoke.jsonl
 
 perf-gate:         ## committed budgets vs the evidence streams (docs/PERFORMANCE.md "The perf gate"): must PASS on the current tree, then must FIRE on an injected synthetic regression
 	python scripts/perf_gate.py --fresh-cost /tmp/perf_gate_cost.jsonl

@@ -761,6 +761,223 @@ def ring_main(n_devices: int, per_device_nodes: int = None):
     return record
 
 
+def flash_main(steps: int = 6, n: int = 128, k: int = 16,
+               num_degrees: int = 4, dim: int = 16):
+    """`python bench.py --flash`: fused-vs-XLA streaming-attention A/B
+    on the CPU toy bench (the ISSUE 11 acceptance harness).
+
+    Builds the SAME conv-weighted attention toy model twice — the
+    unfused trunk (materialized basis + gathered/keyed features +
+    scores) and the fuse_pairwise streaming path
+    (kernels.pallas_flash, identical parameters) — and measures a
+    jitted value_and_grad TRAIN step per arm, best-of-two windows.
+    Peak HBM comes from the PR 6 cost ledger on each arm's compiled
+    executable, so the before/after activation-memory claim is a
+    ledger entry, not prose. Prints ONE bench-shaped JSON line whose
+    value is the fused arm's nodes*steps/s; scripts/flash_smoke.py
+    wraps the payload into the schema'd `flash` record and
+    PERF_BUDGETS.json enforces the step-time and peak-HBM wins plus
+    the fused equivariance gate. Never compared against the RECORD
+    anchors: different program."""
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+    import numpy as np
+
+    from se3_transformer_tpu.models.se3_transformer import (
+        SE3TransformerModule,
+    )
+    from se3_transformer_tpu.observability.costs import cost_payload
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    from se3_transformer_tpu.utils.validation import equivariance_l2
+
+    enable_compilation_cache()
+    rng = np.random.RandomState(0)
+    feats = jnp.asarray(rng.normal(size=(1, n, dim)), jnp.float32)
+    coors = jnp.asarray(np.cumsum(rng.normal(size=(1, n, 3)), axis=1),
+                        jnp.float32)
+    mask = jnp.ones((1, n), bool)
+    kw = dict(dim=dim, depth=1, num_degrees=num_degrees,
+              output_degrees=2, reduce_dim_out=True, attend_self=True,
+              use_null_kv=True, num_neighbors=k, heads=2, dim_head=8,
+              tie_key_values=True, shared_radial_hidden=True)
+    unfused = SE3TransformerModule(**kw)
+    fused = SE3TransformerModule(fuse_pairwise=True, **kw)
+    params = jax.jit(fused.init, static_argnames=('return_type',))(
+        jax.random.PRNGKey(0), feats, coors, mask=mask,
+        return_type=1)['params']
+
+    arms = {}
+    for arm, mod in (('unfused', unfused), ('fused', fused)):
+        def loss(p, mod=mod):
+            out = mod.apply({'params': p}, feats, coors, mask=mask,
+                            return_type=1)
+            return (out ** 2).mean()
+        compiled = jax.jit(jax.value_and_grad(loss)).lower(
+            params).compile()
+        cost = cost_payload(compiled, label=f'flash_ab_{arm}')
+        _, g = compiled(params)
+        jax.block_until_ready(g)                  # warmup
+        arms[arm] = dict(compiled=compiled, cost=cost,
+                         peak_hbm_bytes=cost['peak_bytes'], best=None)
+    # ALTERNATING windows (the tune_kernels A/B-pair discipline): a
+    # monotonic host-load drift then hits both arms equally instead of
+    # whichever arm happened to run second
+    for _ in range(3):
+        for arm in ('unfused', 'fused'):
+            compiled = arms[arm]['compiled']
+            t0 = time.monotonic()
+            for _ in range(steps):
+                _, g = compiled(params)
+            jax.block_until_ready(g)
+            dt = (time.monotonic() - t0) / steps
+            if arms[arm]['best'] is None or dt < arms[arm]['best']:
+                arms[arm]['best'] = dt
+    for arm in ('unfused', 'fused'):
+        arms[arm]['step_ms'] = round(arms[arm].pop('best') * 1e3, 2)
+        del arms[arm]['compiled']
+        print(f'{arm}: {arms[arm]["step_ms"]} ms/step, peak '
+              f'{arms[arm]["peak_hbm_bytes"] / 2**20:.1f} MiB',
+              file=sys.stderr)
+
+    out_u = unfused.apply({'params': params}, feats, coors, mask=mask,
+                          return_type=1)
+    out_f = fused.apply({'params': params}, feats, coors, mask=mask,
+                        return_type=1)
+    parity = float(jnp.abs(out_u - out_f).max())
+    eq = equivariance_l2(fused, params, feats, coors, mask)
+
+    # global (graph-free) scenario: the large-assembly variant with NO
+    # kNN truncation — streaming per-tile rel_pos/radial/payload vs the
+    # materialized all-pairs formulation of the same function. Guarded:
+    # a failure here must not lose the kNN A/B already measured.
+    global_payload = None
+    try:
+        global_payload = _flash_global_ab(steps=max(2, steps // 2))
+    except Exception as e:  # noqa: BLE001
+        print(f'global-scenario A/B failed ({type(e).__name__}: {e}); '
+              f'recording the kNN A/B without it', file=sys.stderr)
+
+    fused_s = arms['fused']['step_ms'] / 1e3
+    record = {
+        'metric': f'flash_attention_ab_nodes_steps_per_sec'
+                  f'(dim={dim},n={n},k={k},deg={num_degrees},'
+                  f'backend=cpu)',
+        'value': round(n / fused_s, 2),
+        'unit': 'nodes*steps/sec/cpu-host',
+        'vs_baseline': 1.0,     # own-program A/B; anchors don't apply
+        'mode': 'flash_ab',
+        'timing': 'best-of-3-alternating',
+        'fused_step_ms': arms['fused']['step_ms'],
+        'unfused_step_ms': arms['unfused']['step_ms'],
+        'fused_vs_unfused': round(
+            arms['unfused']['step_ms'] / arms['fused']['step_ms'], 3),
+        'parity_l2': parity,
+        'equivariance_l2_fused': eq,
+        'peak_hbm_fused': arms['fused']['peak_hbm_bytes'],
+        'peak_hbm_unfused': arms['unfused']['peak_hbm_bytes'],
+        'hbm_unfused_vs_fused': round(
+            arms['unfused']['peak_hbm_bytes']
+            / max(arms['fused']['peak_hbm_bytes'], 1), 3),
+        'cost': {arm: rec['cost'] for arm, rec in arms.items()},
+    }
+    if global_payload is not None:
+        record['global'] = global_payload
+    if os.environ.get('SE3_TPU_CODE_REV'):
+        record['code_rev'] = os.environ['SE3_TPU_CODE_REV']
+    print(json.dumps(record))
+    return record
+
+
+def _flash_global_ab(n: int = 192, steps: int = 3):
+    """Streaming global attention vs the materialized all-pairs
+    reference (forward, one output degree): step ms + ledgered peak
+    bytes both arms. The payload the --flash record carries for the
+    graph-free scenario."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from se3_transformer_tpu.kernels import pallas_flash as pf
+    from se3_transformer_tpu.observability.costs import cost_payload
+
+    rng = np.random.RandomState(3)
+    B, heads, kv_h, dim_head, mid = 1, 2, 2, 8, 32
+    pairs = ((0, 8), (1, 8))
+    d_out = 1
+    Dh = dim_head * (2 * d_out + 1)
+    IF = sum(c * (2 * min(d, d_out) + 1) for d, c in pairs)
+    O = kv_h * dim_head
+    q = jnp.asarray(rng.normal(size=(B, n, heads, Dh)), jnp.float32)
+    xs = tuple(jnp.asarray(rng.normal(size=(B, n, c, 2 * d + 1)),
+                           jnp.float32) for d, c in pairs)
+    coords = jnp.asarray(rng.normal(size=(B, n, 3)) * 2, jnp.float32)
+    rp = tuple(jnp.asarray(rng.normal(size=s), jnp.float32) * 0.3
+               for s in [(1, mid), (mid,), (mid,), (mid,), (mid, mid),
+                         (mid,), (mid,), (mid,)])
+    wv = jnp.asarray(rng.normal(size=(mid, IF, O)), jnp.float32)
+    bv = jnp.asarray(rng.normal(size=(IF, O)), jnp.float32)
+    scale = dim_head ** -0.5
+    cfg = pf.FlashConfig(pairs=pairs, d_out=d_out, heads=heads,
+                         kv_heads=kv_h, scale=scale, arm_v='dense',
+                         arm_k='dense', tie=True)
+    consts = {k: jnp.asarray(v, jnp.float32)
+              for k, v in pf._arm_consts(cfg).items()}
+
+    def streaming(c):
+        return pf.flash_global_attention(
+            q, xs, c, rp, wv, bv, pairs=pairs, d_out=d_out, heads=heads,
+            kv_heads=kv_h, scale=scale, arm='dense', pallas=False)
+
+    def materialized(c):
+        rel = c[:, :, None, :] - c[:, None, :, :]
+        h = pf._radial_apply(pf._safe_dist(rel)[..., None], rp)
+        sh = pf.flash_sh_payload(rel, pf._sh_degree(cfg),
+                                 differentiable=True)
+        xg = tuple(jnp.broadcast_to(x[:, None], (B, n, *x.shape[1:]))
+                   for x in xs)
+        kv = pf._kv_block('dense', pairs, d_out, xg, h, sh, None, wv,
+                          bv, consts).reshape(B, n, n, kv_h, Dh)
+        notself = (jnp.arange(n)[:, None] != jnp.arange(n)[None])[None]
+        return pf._row_attention(cfg, q, kv, kv, notself)
+
+    out = {}
+    parity = None
+    fns = dict(streaming=streaming, materialized=materialized)
+    compiled = {}
+    results = {}
+    for arm, fn in fns.items():
+        compiled[arm] = jax.jit(fn).lower(coords).compile()
+        cost = cost_payload(compiled[arm], label=f'flash_global_{arm}')
+        results[arm] = compiled[arm](coords)
+        jax.block_until_ready(results[arm])
+        out[arm] = dict(peak_hbm_bytes=cost['peak_bytes'], best=None)
+    parity = float(jnp.abs(results['streaming']
+                           - results['materialized']).max())
+    for _ in range(2):      # alternating windows, like the kNN A/B
+        for arm in fns:
+            t0 = time.monotonic()
+            for _ in range(steps):
+                r = compiled[arm](coords)
+            jax.block_until_ready(r)
+            dt = (time.monotonic() - t0) / steps
+            if out[arm]['best'] is None or dt < out[arm]['best']:
+                out[arm]['best'] = dt
+    for arm in fns:
+        out[arm]['step_ms'] = round(out[arm].pop('best') * 1e3, 2)
+    return dict(
+        n=n, parity_l2=parity,
+        streaming_step_ms=out['streaming']['step_ms'],
+        materialized_step_ms=out['materialized']['step_ms'],
+        peak_hbm_streaming=out['streaming']['peak_hbm_bytes'],
+        peak_hbm_materialized=out['materialized']['peak_hbm_bytes'],
+        hbm_materialized_vs_streaming=round(
+            out['materialized']['peak_hbm_bytes']
+            / max(out['streaming']['peak_hbm_bytes'], 1), 3))
+
+
 def degrees_main(degrees, dense_max: int = 4, steps: int = 5):
     """`python bench.py --degrees 2,4,6`: per-degree so2-vs-dense A/B on
     the CPU toy bench (the ROADMAP item 2 acceptance harness).
@@ -805,20 +1022,27 @@ def degrees_main(degrees, dense_max: int = 4, steps: int = 5):
                         jnp.float32)
     mask = jnp.ones((1, n), bool)
 
-    def bench_forward(mod, params):
+    from se3_transformer_tpu.observability.costs import cost_payload
+
+    def bench_forward(mod, params, label):
         fwd = jax.jit(lambda c: mod.apply({'params': params}, feats, c,
                                           mask=mask, return_type=1))
-        out = fwd(coors)
-        out.block_until_ready()                       # warmup compile
+        # AOT-compile so the SAME executable serves the cost ledger and
+        # the timed windows (the --ring / --flash discipline): each
+        # arm's peak-HBM split is a ledger entry, not prose
+        compiled = fwd.lower(coors).compile()
+        cost = cost_payload(compiled, label=label)
+        out = compiled(coors)
+        out.block_until_ready()                       # warmup
         best = None
         for _ in range(2):
             t0 = time.monotonic()
             for _ in range(steps):
-                out = fwd(coors)
+                out = compiled(coors)
             out.block_until_ready()
             dt = (time.monotonic() - t0) / steps
             best = dt if best is None or dt < best else best
-        return best
+        return best, cost
 
     per_degree = {}
     for d in degrees:
@@ -833,12 +1057,15 @@ def degrees_main(degrees, dense_max: int = 4, steps: int = 5):
                          static_argnames=('return_type',))(
             jax.random.PRNGKey(0), feats, coors, mask=mask,
             return_type=1)['params']
-        so2_s = bench_forward(so2_mod, params)
+        so2_s, so2_cost = bench_forward(so2_mod, params,
+                                        f'so2_sweep_d{d}_so2')
         entry = dict(
             so2_step_ms=round(so2_s * 1e3, 2),
             so2_nodes_steps_per_sec=round(n / so2_s, 2),
             equivariance_l2_so2=equivariance_l2(so2_mod, params, feats,
-                                                coors, mask))
+                                                coors, mask),
+            so2_peak_hbm_bytes=so2_cost['peak_bytes'],
+            cost={'so2': so2_cost})
         if d <= dense_max:
             dense_mod = SE3TransformerModule(**kw)
             out_d = dense_mod.apply({'params': params}, feats, coors,
@@ -846,9 +1073,14 @@ def degrees_main(degrees, dense_max: int = 4, steps: int = 5):
             out_s = so2_mod.apply({'params': params}, feats, coors,
                                   mask=mask, return_type=1)
             entry['parity_l2'] = float(jnp.abs(out_d - out_s).max())
-            dense_s = bench_forward(dense_mod, params)
+            dense_s, dense_cost = bench_forward(dense_mod, params,
+                                                f'so2_sweep_d{d}_dense')
             entry['dense_step_ms'] = round(dense_s * 1e3, 2)
             entry['dense_vs_so2'] = round(dense_s / so2_s, 3)
+            # per-arm peak-HBM split: the so2 memory claim rides the
+            # ledger (like --ring's per-arm cost payloads), not prose
+            entry['dense_peak_hbm_bytes'] = dense_cost['peak_bytes']
+            entry['cost']['dense'] = dense_cost
         per_degree[str(d)] = entry
         print(f'degree {d}: {entry}', file=sys.stderr)
 
@@ -871,6 +1103,15 @@ def degrees_main(degrees, dense_max: int = 4, steps: int = 5):
 
 
 if __name__ == '__main__':
+    if '--flash' in sys.argv[1:]:
+        # CPU A/B harness (no device probe, like --degrees): streaming
+        # fused attention vs the unfused trunk, flags parsed before jax
+        # initializes its backends
+        _steps = 6
+        if '--steps' in sys.argv[1:]:
+            _steps = int(sys.argv[sys.argv.index('--steps') + 1])
+        flash_main(steps=_steps)
+        sys.exit(0)
     if '--degrees' in sys.argv[1:]:
         # CPU A/B harness (no device probe, like --ring): per-degree
         # so2-vs-dense comparison, flags parsed before jax initializes

@@ -1,0 +1,158 @@
+"""CPU gate for the streaming flash-attention path (`make flash-smoke`).
+
+Four gates, exit non-zero on any failure:
+
+  1. PARITY — the fuse_pairwise streaming path vs the unfused trunk on
+     IDENTICAL parameters must agree within 1e-4 max-abs, for BOTH
+     contraction arms (dense CG and so2 banded), under a real node mask
+     (padded rows) — the fused path computes the same function, so this
+     is roundoff (~1e-7 in practice). Checked through the XLA streaming
+     dispatch AND the interpret-mode Pallas kernel, so the kernel body
+     itself is gated in tier-1-class time on CPU.
+  2. EQUIVARIANCE — the fused path's equivariance L2 must stay under
+     1e-4 at num_degrees 2 and 4 (the so2 arm's higher degrees are
+     gated by tests/test_flash.py and the so2 sweep).
+  3. A/B RECORD — bench.flash_main's fused-vs-unfused train-step A/B
+     (step ms both arms, peak HBM from the PR 6 cost ledger, fused
+     equivariance) is written as a schema'd `flash` record.
+  4. The Makefile target then runs `obs_report --require flash` and
+     `perf_gate.py` on the stream, so the committed step-time and
+     peak-HBM win budgets judge the fresh numbers.
+
+    python scripts/flash_smoke.py [--metrics FLASH.jsonl] [--steps 6]
+"""
+import argparse
+import json
+import os
+import sys
+import uuid
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+PARITY_TOL = 1e-4
+EQ_TOL = 1e-4
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='streaming flash-attention parity + equivariance + '
+                    'A/B record gate')
+    ap.add_argument('--metrics', default=None,
+                    help='write the schema-valid flash stream here')
+    ap.add_argument('--steps', type=int, default=6)
+    args = ap.parse_args(argv)
+
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+    import numpy as np
+
+    from se3_transformer_tpu.models.se3_transformer import (
+        SE3TransformerModule,
+    )
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    from se3_transformer_tpu.utils.validation import equivariance_l2
+
+    enable_compilation_cache()
+    ok = True
+    rng = np.random.RandomState(0)
+    n, dim, k = 24, 8, 6
+    feats = jnp.asarray(rng.normal(size=(1, n, dim)), jnp.float32)
+    coors = jnp.asarray(np.cumsum(rng.normal(size=(1, n, 3)), axis=1),
+                        jnp.float32)
+    # padded batch: the trailing rows are mask=False — parity must hold
+    # on the real rows (the left-padded [global, null, self, neighbors]
+    # slot order and the masked-slot semantics are exercised together)
+    mask = jnp.asarray(np.arange(n) < n - 5)[None]
+
+    kw = dict(dim=dim, depth=1, num_degrees=3, output_degrees=2,
+              reduce_dim_out=True, attend_self=True, use_null_kv=True,
+              num_neighbors=k, heads=2, dim_head=4,
+              shared_radial_hidden=True)
+    for backend in ('dense', 'so2'):
+        unf = SE3TransformerModule(conv_backend=backend, **kw)
+        fus = SE3TransformerModule(conv_backend=backend,
+                                   fuse_pairwise=True, **kw)
+        params = jax.jit(fus.init, static_argnames=('return_type',))(
+            jax.random.PRNGKey(0), feats, coors, mask=mask,
+            return_type=1)['params']
+        ref = unf.apply({'params': params}, feats, coors, mask=mask,
+                        return_type=1)
+        for label, mod in (
+                (f'{backend}-arm stream', fus),
+                (f'{backend}-arm pallas-interpret',
+                 SE3TransformerModule(conv_backend=backend,
+                                      fuse_pairwise=True,
+                                      flash_interpret=True, **kw))):
+            out = mod.apply({'params': params}, feats, coors, mask=mask,
+                            return_type=1)
+            diff = float(jnp.abs(out - ref).max())
+            print(f'{label} parity vs unfused: {diff:.3g}')
+            if not diff < PARITY_TOL:
+                print(f'FAIL: {label} parity {diff} >= {PARITY_TOL}')
+                ok = False
+
+    for deg in (2, 4):
+        fus = SE3TransformerModule(fuse_pairwise=True,
+                                   **{**kw, 'num_degrees': deg})
+        params = jax.jit(fus.init, static_argnames=('return_type',))(
+            jax.random.PRNGKey(0), feats, coors, mask=mask,
+            return_type=1)['params']
+        eq = equivariance_l2(fus, params, feats, coors, mask)
+        print(f'fused equivariance L2 at num_degrees={deg}: {eq:.3g}')
+        if not eq < EQ_TOL:
+            print(f'FAIL: fused equivariance {eq} >= {EQ_TOL} at '
+                  f'num_degrees={deg}')
+            ok = False
+
+    # the A/B runs in a FRESH subprocess: the parity/equivariance stage
+    # above leaves this process with a dozen compiled models' allocator
+    # and thread-pool state, which measurably (and one-sidedly) taxes
+    # the streaming arm's chunked windows — a clean `python bench.py
+    # --flash` is both the documented entry point and the honest timer
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'bench.py'), '--flash',
+         '--steps', str(args.steps)],
+        capture_output=True, text=True, cwd=REPO)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        print(f'FAIL: bench.py --flash exited {proc.returncode}')
+        return 1
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    if args.metrics:
+        from se3_transformer_tpu.observability.report import (
+            write_record_stream,
+        )
+        from se3_transformer_tpu.observability.schema import (
+            validate_stream,
+        )
+        body = dict(kind='flash', label=record['metric'],
+                    value=record['value'], unit=record['unit'],
+                    timing=record['timing'],
+                    **{key: record[key] for key in (
+                        'fused_step_ms', 'unfused_step_ms',
+                        'fused_vs_unfused', 'parity_l2',
+                        'equivariance_l2_fused', 'peak_hbm_fused',
+                        'peak_hbm_unfused', 'hbm_unfused_vs_fused',
+                        'cost')})
+        write_record_stream(args.metrics,
+                            f'flash_smoke_{uuid.uuid4().hex[:8]}', [body])
+        info = validate_stream(args.metrics)
+        print(f'schema ok: {info["records"]} records {info["kinds"]}')
+
+    summary = dict(ok=ok,
+                   fused_vs_unfused=record['fused_vs_unfused'],
+                   hbm_unfused_vs_fused=record['hbm_unfused_vs_fused'],
+                   equivariance_l2_fused=record['equivariance_l2_fused'])
+    print(json.dumps(summary))
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

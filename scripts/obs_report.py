@@ -229,10 +229,39 @@ def _gate_so2_sweep(records):
     return True
 
 
+def _gate_flash(records):
+    recs = [r for r in records if r.get('kind') == 'flash']
+    if not recs:
+        print('FLASH GATE: no flash records in the stream (was '
+              'scripts/flash_smoke.py / bench.py --flash run?)',
+              file=sys.stderr)
+        return False
+    last = recs[-1]
+    eq = last.get('equivariance_l2_fused')
+    if not isinstance(eq, (int, float)) or eq >= 1e-4:
+        print(f'FLASH GATE: fused equivariance L2 {eq!r} >= 1e-4 (or '
+              f'missing) — the streaming kernel broke equivariance',
+              file=sys.stderr)
+        return False
+    ratios = {k: last.get(k) for k in ('fused_vs_unfused',
+                                       'hbm_unfused_vs_fused')}
+    if any(not isinstance(v, (int, float)) or v <= 0
+           for v in ratios.values()):
+        print(f'FLASH GATE: degenerate A/B ratios {ratios} — the record '
+              f'proves no fused-vs-unfused comparison', file=sys.stderr)
+        return False
+    print(f'flash gate ok: {len(recs)} flash records, step ratio '
+          f'{ratios["fused_vs_unfused"]}, peak-HBM ratio '
+          f'{ratios["hbm_unfused_vs_fused"]}, eq {eq:.2e} (the wins '
+          f'themselves are enforced by scripts/perf_gate.py)',
+          file=sys.stderr)
+    return True
+
+
 _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       tune=_gate_tune, cost=_gate_cost,
                       profile=_gate_profile, serve=_gate_serve,
-                      so2_sweep=_gate_so2_sweep)
+                      so2_sweep=_gate_so2_sweep, flash=_gate_flash)
 
 
 def main(argv=None):

@@ -63,8 +63,12 @@ DEFAULT_BUDGETS = os.path.join(REPO, 'PERF_BUDGETS.json')
 # continuous-admission proof bit) are judged by a plain `make perf-gate`.
 # SO2_SWEEP.jsonl: the banked `make so2-smoke` degree-sweep stream, so
 # the so2-vs-dense degree-4 win + throughput floor are judged too.
+# FLASH_AB.jsonl: the banked `make flash-smoke` streaming-attention A/B
+# stream, so the fused arm's step-time + peak-HBM wins and its
+# equivariance gate are judged by a plain `make perf-gate`.
 DEFAULT_RECORDS = ('BENCH_r05.json', 'WIDTH_TABLE.jsonl',
-                   'SERVE_MULTI.jsonl', 'SO2_SWEEP.jsonl')
+                   'SERVE_MULTI.jsonl', 'SO2_SWEEP.jsonl',
+                   'FLASH_AB.jsonl')
 
 
 # --------------------------------------------------------------------- #

@@ -95,6 +95,11 @@ def _build_step(args):
         # --conv-backend so2 traces the banded SO(2) path instead, so
         # the 'so2' kind's streaming chunks become tuning targets
         num_nodes, dim = args.nodes or 32, 8
+        # --fuse-pairwise routes the attention blocks through the
+        # streaming flash kernel (interpret mode), so the 'flash' /
+        # 'flash_stream' kinds become tuning targets; --pallas-attention
+        # enables the per-degree fused attention kernel so 'attention'
+        # AND 'attention_bwd' picks resolve in the traced train step
         module = SE3TransformerModule(
             num_tokens=24, dim=dim, dim_head=8, heads=2, depth=1,
             attend_self=True, input_degrees=1, num_degrees=2,
@@ -102,12 +107,22 @@ def _build_step(args):
             differentiable_coors=True, num_neighbors=8,
             pallas=True, pallas_interpret=True,
             fuse_basis=args.fuse_basis,
+            fuse_pairwise=args.fuse_pairwise,
+            flash_interpret=args.fuse_pairwise,
+            shared_radial_hidden=args.fuse_pairwise,
+            pallas_attention=args.pallas_attention or None,
+            pallas_attention_interpret=args.pallas_attention,
             conv_backend=args.conv_backend)
         label = f'smoke,dim={dim},interpret,{args.conv_backend}'
     else:
         num_nodes = args.nodes or 1024
-        module = recipes.RECIPES[args.recipe](
-            dim=args.dim, output_degrees=2, reduce_dim_out=True)
+        overrides = dict(output_degrees=2, reduce_dim_out=True)
+        if args.fuse_pairwise:
+            overrides.update(fuse_pairwise=True,
+                             shared_radial_hidden=True)
+        if args.pallas_attention:
+            overrides['pallas_attention'] = True
+        module = recipes.RECIPES[args.recipe](dim=args.dim, **overrides)
         label = f'{args.recipe},dim={args.dim}'
 
     rng = np.random.RandomState(0)
@@ -205,7 +220,9 @@ def main(argv=None):
     ap.add_argument('--dim', type=int, default=64)
     ap.add_argument('--nodes', type=int, default=0)
     ap.add_argument('--kinds', nargs='+',
-                    default=['plain', 'bx', 'bxf', 'attention', 'so2'])
+                    default=['plain', 'bx', 'bxf', 'attention',
+                             'attention_bwd', 'so2', 'flash',
+                             'flash_stream'])
     ap.add_argument('--conv-backend', default='dense',
                     help="smoke module's conv backend ('dense'|'so2');"
                          " 'so2' makes the banded contraction's chunk "
@@ -219,6 +236,14 @@ def main(argv=None):
     ap.add_argument('--fuse-basis', action='store_true',
                     help='smoke: exercise the bx/bxf kinds instead of '
                          'plain')
+    ap.add_argument('--fuse-pairwise', action='store_true',
+                    help='route attention through the streaming flash '
+                         'kernel so the flash/flash_stream kinds become '
+                         'tuning targets (implies shared_radial_hidden)')
+    ap.add_argument('--pallas-attention', action='store_true',
+                    help='enable the per-degree fused attention kernel '
+                         "so the 'attention' and 'attention_bwd' kinds "
+                         'become tuning targets')
     args = ap.parse_args(argv)
 
     if args.smoke:

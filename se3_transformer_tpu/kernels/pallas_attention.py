@@ -119,13 +119,18 @@ def _block_row_bytes(J: int, D: int, bwd: bool) -> int:
     return (2 * blocks + temps) * 4
 
 
-def _pick_block_n(n: int, J: int, D: int, bwd: bool = False) -> int:
-    """block_n resolution (forward): the measured shape-keyed table
-    (kernels.tuning, kind 'attention' — the tuner admits candidates
-    against the BACKWARD row model, since training differentiates with
-    the same block family) first, then the VMEM-ladder heuristic. The
-    backward always runs the heuristic against its own ~2x row model;
-    with an empty table every pick is bit-identical to the heuristic."""
+def _pick_block_n(n: int, J: int, D: int, bwd: bool = False,
+                  dtype: str = 'float32') -> int:
+    """block_n resolution: the measured shape-keyed table
+    (kernels.tuning) first, then the VMEM-ladder heuristic. The forward
+    consults kind 'attention' (the tuner admits candidates against the
+    BACKWARD row model, since training differentiates with the same
+    block family); the backward consults its OWN kind 'attention_bwd'
+    against its ~2x row model — previously the bwd ran the heuristic
+    only, so scripts/tune_kernels.py could never promote a measured bwd
+    block. `dtype` is the storage dtype of the q/k/v operands and keys
+    the table entry. With an empty table every pick is bit-identical to
+    the heuristic."""
     row = _block_row_bytes(J, D, bwd)
     cap = max(8, _round_up(n, 8))  # a tiny input must not pad to a full
     # 512-row block
@@ -136,21 +141,20 @@ def _pick_block_n(n: int, J: int, D: int, bwd: bool = False) -> int:
                 return min(block_n, cap)
         return 8
 
-    if bwd:
-        return _heuristic()
     from . import tuning
-    hit = tuning.lookup('attention', (n, J, D))
+    kind = 'attention_bwd' if bwd else 'attention'
+    hit = tuning.lookup(kind, (n, J, D), dtype=dtype)
     if hit is not None:
         blocks, source = hit
         if len(blocks) == 1 and (
                 source == 'forced'
-                or tuning.validate_entry('attention', (n, J, D), blocks)):
+                or tuning.validate_entry(kind, (n, J, D), blocks)):
             block_n = min(int(blocks[0]), cap)
-            tuning.record_consult('attention', (n, J, D), 'float32',
+            tuning.record_consult(kind, (n, J, D), dtype,
                                   source, (block_n,))
             return block_n
     block_n = _heuristic()
-    tuning.record_consult('attention', (n, J, D), 'float32', 'heuristic',
+    tuning.record_consult(kind, (n, J, D), dtype, 'heuristic',
                           (block_n,))
     return block_n
 
@@ -177,7 +181,7 @@ def _fused_attention_fwd_impl(q, k, v, mask, heads: int, scale: float,
     BKV, _, J, _ = k.shape
     group = BH // BKV
 
-    block_n = _pick_block_n(n, J, D)
+    block_n = _pick_block_n(n, J, D, dtype=jnp.dtype(q.dtype).name)
     np_ = _round_up(n, block_n)
     if np_ != n:
         q = jnp.pad(q, ((0, 0), (0, np_ - n), (0, 0)))
@@ -282,8 +286,10 @@ def _fused_attention_bwd_impl(q, k, v, mask, g, heads: int, scale: float,
     BKV, _, J, _ = k.shape
     group = BH // BKV
 
-    # the backward holds ~2x the forward's kv-sized blocks (dk/dv outputs)
-    block_n = _pick_block_n(n, J, D, bwd=True)
+    # the backward holds ~2x the forward's kv-sized blocks (dk/dv
+    # outputs); kind 'attention_bwd' keys its own measured entries
+    block_n = _pick_block_n(n, J, D, bwd=True,
+                            dtype=jnp.dtype(q.dtype).name)
     np_ = _round_up(n, block_n)
     if np_ != n:
         pad = ((0, 0), (0, np_ - n), (0, 0))

@@ -53,11 +53,19 @@ CACHE_VERSION = 1
 # kernel kinds with tunable picks. 'plain'/'bx'/'bxf' are the pairwise
 # forward kernels (the backward ALWAYS runs its own bwd-model heuristic
 # — overrides and table entries never reach it, see _pick_blocks);
-# 'attention' is the fused attention forward block_n; 'so2' is the
-# banded SO(2) contraction's node-axis streaming chunk count
+# 'attention' is the fused attention forward block_n and
+# 'attention_bwd' the fused attention BACKWARD block_n (its working set
+# is ~2x the forward's, so it keys its own measured entries —
+# previously the bwd ran the heuristic only and the tuner could never
+# promote a measured bwd block); 'so2' is the banded SO(2)
+# contraction's node-axis streaming chunk count
 # (so2/contract.py::_pick_so2_chunks — blocks = (chunks,), 1 =
-# unchunked).
-KINDS = ('plain', 'bx', 'bxf', 'attention', 'so2')
+# unchunked); 'flash' is the streaming equivariant-attention kernel's
+# (block_n, block_j) tile pair (kernels/pallas_flash.py) and
+# 'flash_stream' its XLA fallback's node-axis chunk count
+# (blocks = (chunks,), 1 = unchunked).
+KINDS = ('plain', 'bx', 'bxf', 'attention', 'attention_bwd', 'so2',
+         'flash', 'flash_stream')
 
 # Mosaic's scoped-vmem stack limit is ~16 MiB; 12 MiB leaves slack for
 # compiler temporaries (same constant, same hard-won reason, as
@@ -275,12 +283,14 @@ def clear_kernel_caches() -> int:
     (a silent no-op would let an A/B measure the same program twice —
     the invalid-pair failure mode of the retired env-var sweep)."""
     cleared = 0
-    from . import pallas_attention as pa, pallas_pairwise as pp
+    from . import pallas_attention as pa, pallas_flash as pf, \
+        pallas_pairwise as pp
     for mod, names in (
             (pp, ('fused_pairwise_conv', 'fused_pairwise_conv_bx',
                   'fused_pairwise_conv_bxf', 'fused_pairwise_conv_bwd')),
             (pa, ('_fused_attention_fwd_impl',
-                  '_fused_attention_bwd_impl'))):
+                  '_fused_attention_bwd_impl')),
+            (pf, ('_flash_fwd_impl',))):
         for nm in names:
             f = getattr(mod, nm, None)
             if f is not None and hasattr(f, 'clear_cache'):
@@ -394,6 +404,14 @@ def admissible_candidates(kind: str, shape: Sequence[int]
         model (`_block_row_bytes(J, D, bwd=True)`): training
         differentiates attention with the same block size family, so a
         forward-only fit would still OOM end-to-end.
+      * 'attention_bwd': the backward's own block_n ladder, admitted
+        against the same bwd row model (the bwd IS the bwd program —
+        forward entries never steer it and vice versa).
+      * 'flash': (block_n, block_j) for the streaming
+        equivariant-attention kernel, admitted against its bwd-aware
+        VMEM row model (pallas_flash._flash_vmem_bytes).
+      * 'flash_stream': node-axis chunk count for the kernel's XLA
+        streaming fallback (1 = unchunked), the so2-kind pattern.
     """
     out: List[Tuple[int, ...]] = []
     if kind == 'plain':
@@ -425,16 +443,30 @@ def admissible_candidates(kind: str, shape: Sequence[int]
                 if _vmem_bx(be, cb, O, P, Q, F, mid) \
                         <= MOSAIC_SCOPED_VMEM:
                     out.append((be, cb))
-    elif kind == 'attention':
+    elif kind in ('attention', 'attention_bwd'):
         from .pallas_attention import (
             _VMEM_LIMIT, _block_row_bytes, _round_up,
         )
         n, J, D = (int(s) for s in shape)
+        # both kinds admit against the BACKWARD row model: a forward
+        # entry still has to coexist with the bwd program end-to-end,
+        # and the bwd kind's working set IS the bwd model
         row_bwd = _block_row_bytes(J, D, bwd=True)
         cap = max(8, _round_up(n, 8))
         for bn in (512, 256, 128, 64, 32, 16, 8):
             if bn <= cap and bn * row_bwd <= _VMEM_LIMIT:
                 out.append((bn,))
+    elif kind == 'flash':
+        from .pallas_flash import flash_admissible_blocks
+        out = flash_admissible_blocks(shape)
+    elif kind == 'flash_stream':
+        # node-axis chunk count for the XLA streaming fallback
+        # (pallas_flash._flash_stream). The ladder must cover the
+        # heuristic's own operating region (n // 16 — e.g. 64 chunks at
+        # flagship n=1024), or the tuner could never measure it and
+        # validate_entry would reject larger measured entries as corrupt
+        n = int(shape[0])
+        out = [(c,) for c in (1, 2, 4, 8, 16, 32, 64, 128) if c <= n]
     elif kind == 'so2':
         # node-axis streaming chunk count for the banded SO(2)
         # contraction (so2/contract.py): 1 = unchunked (the heuristic

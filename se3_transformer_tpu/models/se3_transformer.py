@@ -21,6 +21,7 @@ is what you jit / pjit / shard.
 """
 from __future__ import annotations
 
+import re
 from functools import partial
 from typing import Dict, Optional, Tuple, Union
 
@@ -124,6 +125,24 @@ class SE3TransformerModule(nn.Module):
     # only for layers that need them; so2 edge frames likewise — an
     # all-so2 model never pays the O(P*Q*F) per-edge basis at all.
     conv_backend: BackendSpec = 'dense'
+    # streaming flash-style attention (kernels.pallas_flash): route a
+    # block's k/v + attention through ONE kernel that rebuilds the
+    # pairwise contraction per VMEM tile with an online softmax — the
+    # per-edge basis, the gathered/keyed features, and the [b, h, n, J]
+    # scores never exist in HBM, and the recompute-in-backward
+    # custom_vjp composes with reversible=True for near-O(1) activation
+    # memory. True/False applies to every attention block; or
+    # first-match-wins (block-name regex, 'flash'|'xla') pairs mirror
+    # conv_backend's per-layer selection, e.g.
+    # (('attn_block[01]', 'flash'), ('.*', 'xla')). Block names:
+    # 'attn_block{i}'. The dense CG arm and the so2 banded arm are
+    # selected by conv_backend per to_v/to_k layer as usual. Implies
+    # the shared-radial grouped parameter layout for the fused blocks'
+    # kv convs (checkpoint-compatible with shared_radial_hidden=True).
+    # Unsupported alongside rotary embeddings, linear_proj_keys, and
+    # sequence_parallel.
+    fuse_pairwise: Union[bool, Tuple[Tuple[str, str], ...]] = False
+    flash_interpret: bool = False  # tests: interpreter-mode flash kernel
     # None -> auto (Pallas fused pairwise kernel on TPU, XLA elsewhere)
     pallas: Optional[bool] = None
     # contract the angular basis inside the pairwise kernel (forward):
@@ -194,6 +213,12 @@ class SE3TransformerModule(nn.Module):
             object.__setattr__(
                 self, 'conv_backend',
                 tuple((str(p), str(b)) for p, b in items))
+        fp = self.fuse_pairwise
+        if not isinstance(fp, (bool, tuple)):
+            items = fp.items() if hasattr(fp, 'items') else fp
+            object.__setattr__(
+                self, 'fuse_pairwise',
+                tuple((str(p), str(v)) for p, v in items))
         super().__post_init__()
 
     # ------------------------------------------------------------------ #
@@ -253,6 +278,15 @@ class SE3TransformerModule(nn.Module):
             'adjacency matrix must be passed in when attending to sparse neighbors'
         assert not (self.has_edges and edges is None), \
             'edge tokens/features must be supplied when edge_dim is set'
+        if any(self._attention_fused()):
+            assert self.sequence_parallel is None, \
+                'fuse_pairwise streams its own gathers and does not ' \
+                'compose with the sequence-parallel ring exchange yet'
+            assert not (self.rotary_position or self.rotary_rel_dist), \
+                'fuse_pairwise does not support rotary embeddings'
+            assert not self.linear_proj_keys, \
+                'fuse_pairwise needs conv keys (linear_proj_keys is ' \
+                'the gathered node-projection variant)'
 
         if output_degrees == 1:
             return_type = 0
@@ -532,6 +566,30 @@ class SE3TransformerModule(nn.Module):
                                                    noise_full)
             return adj_mat, adj_ind_full, sp_full, num_sparse
 
+    def _attention_fused(self):
+        """Per-block streaming-attention resolution from the
+        fuse_pairwise spec (bool, or first-match-wins (pattern,
+        'flash'|'xla') pairs on 'attn_block{i}' — the conv_backend
+        idiom). EGNN trunks have no SE3 attention blocks."""
+        if self.use_egnn:
+            return tuple()
+        spec = self.fuse_pairwise
+        out = []
+        for i in range(self.depth):
+            name = f'attn_block{i}'
+            if isinstance(spec, bool):
+                out.append(spec)
+                continue
+            val = 'xla'
+            for pat, v in spec:
+                if re.search(pat, name):
+                    val = v
+                    break
+            assert val in ('flash', 'xla'), \
+                f'fuse_pairwise rule value {val!r} (want flash|xla)'
+            out.append(val == 'flash')
+        return tuple(out)
+
     def _layer_backends(self, fiber_out):
         """Resolve the conv_backend spec per conv layer (first-match-wins
         on the layer name — ops.conv.resolve_conv_backend). The dict
@@ -556,7 +614,19 @@ class SE3TransformerModule(nn.Module):
         pos_emb = self._rotary_embeddings(b, n, hood)
 
         backends = self._layer_backends(fiber_out)
-        need_dense = 'dense' in backends.values()
+        fused_blocks = self._attention_fused()
+        # a FUSED attention block's kv convs consume the flash payloads
+        # (SH stack / so2 frames) instead of materialized basis tensors
+        fused_conv_names = set()
+        for i, fused in enumerate(fused_blocks):
+            if fused:
+                fused_conv_names.add(f'attn_block{i}/to_v')
+                fused_conv_names.add(f'attn_block{i}/to_k')
+        need_dense = any(b == 'dense' for name, b in backends.items()
+                         if name not in fused_conv_names)
+        need_flash_sh = any(backends[name] == 'dense'
+                            for name in fused_conv_names
+                            if name in backends)
         extra_backends = sorted(set(backends.values()) - {'dense'})
 
         # basis, in-trace (reference :1329). The fused bx kernel path
@@ -577,6 +647,14 @@ class SE3TransformerModule(nn.Module):
                 basis = get_basis(hood.rel_pos, num_degrees - 1,
                                   differentiable=self.differentiable_coors,
                                   layout=layout)
+            if need_flash_sh:
+                # dense-arm flash blocks: the raw SH stack (O(S) floats
+                # per edge) replaces the per-pair basis tensors — an
+                # all-flash dense model never materializes a basis
+                from ..kernels.pallas_flash import flash_sh_payload
+                basis['flash_sh'] = flash_sh_payload(
+                    hood.rel_pos, num_degrees - 1,
+                    differentiable=self.differentiable_coors)
             if 'so2' in extra_backends:
                 from ..so2.frames import edge_frames
                 basis['so2'] = edge_frames(
@@ -721,6 +799,8 @@ class SE3TransformerModule(nn.Module):
             fiber_hidden, depth=self.depth, heads=self.heads,
             dim_head=self.dim_head, attend_self=self.attend_self,
             value_backends=value_backends, key_backends=key_backends,
+            fused_attention=self._attention_fused(),
+            flash_interpret=self.flash_interpret,
             edge_dim=conv_kwargs['edge_dim'],
             use_null_kv=self.use_null_kv,
             fourier_encode_dist=self.fourier_encode_dist,

@@ -72,6 +72,15 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    coverage field (share of device time attributed to
                    known scopes — `make profile-smoke` gates on it);
                    optional roofline utilization vs the bf16 MXU peak.
+  flash            fused-vs-XLA streaming-attention A/B
+                   (bench.flash_main via scripts/flash_smoke.py):
+                   label, fused_step_ms / unfused_step_ms and the
+                   load-bearing trio: fused_vs_unfused (step-time
+                   ratio), hbm_unfused_vs_fused (peak-HBM ratio from
+                   the PR 6 cost ledger — the activation-memory claim)
+                   and equivariance_l2_fused (the streaming kernel must
+                   still be equivariant). `make flash-smoke` gates on
+                   it and PERF_BUDGETS.json enforces both wins.
   so2_sweep        per-degree so2-vs-dense contraction A/B
                    (bench.degrees_main via scripts/so2_smoke.py):
                    label, degrees (per-max-degree {so2_step_ms,
@@ -97,7 +106,7 @@ SCHEMA_VERSION = 1
 
 KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
                'serve', 'tune', 'comm', 'cost', 'profile', 'so2_sweep',
-               'summary')
+               'flash', 'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
@@ -135,6 +144,13 @@ _REQUIRED = {
     # backend contract: a sweep record that cannot say the reduced
     # contraction is still equivariant proves nothing about the speedup
     'so2_sweep': ('run_id', 'label', 'degrees'),
+    # the ratio pair + the equivariance figure are the load-bearing
+    # trio of the streaming-attention contract: a flash record that
+    # cannot say whether the fused arm was faster, smaller, AND still
+    # equivariant proves nothing
+    'flash': ('run_id', 'label', 'fused_step_ms', 'unfused_step_ms',
+              'fused_vs_unfused', 'hbm_unfused_vs_fused',
+              'equivariance_l2_fused'),
     'summary': ('run_id', 'steps', 'metrics', 'timing'),
 }
 
@@ -325,6 +341,15 @@ def validate_record(rec: dict, index=None) -> dict:
             _fail(index, f'profile.device_time_ms must be a '
                          f'non-negative number, got '
                          f'{rec["device_time_ms"]!r}')
+    if kind == 'flash':
+        for field in ('fused_step_ms', 'unfused_step_ms',
+                      'fused_vs_unfused', 'hbm_unfused_vs_fused',
+                      'equivariance_l2_fused'):
+            val = rec[field]
+            if not isinstance(val, (int, float)) or isinstance(val, bool) \
+                    or val < 0:
+                _fail(index, f'flash.{field} must be a non-negative '
+                             f'number, got {val!r}')
     if kind == 'so2_sweep':
         degrees = rec['degrees']
         if not isinstance(degrees, dict) or not degrees:

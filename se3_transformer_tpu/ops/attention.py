@@ -58,6 +58,15 @@ class AttentionSE3(nn.Module):
     # registry; resolved per layer by the model's conv_backend spec)
     backend_v: str = 'dense'
     backend_k: str = 'dense'
+    # fuse_pairwise: route k/v + attention through the streaming
+    # flash kernel (kernels.pallas_flash) — the per-edge basis, the
+    # gathered/keyed features, and the [b, h, n, J] scores never exist
+    # in HBM; the pairwise contraction (dense or so2 arm, per
+    # backend_v/backend_k) runs per VMEM tile with an online softmax
+    # and a recompute-in-backward custom_vjp. Requires
+    # shared_radial_hidden; rotary/linear_proj_keys fall outside it.
+    fuse_pairwise: bool = False
+    flash_interpret: bool = False  # tests: interpreter-mode flash kernel
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -65,6 +74,9 @@ class AttentionSE3(nn.Module):
                  global_feats: Optional[Features] = None,
                  pos_emb: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
                  mask: Optional[jnp.ndarray] = None) -> Features:
+        if self.fuse_pairwise:
+            return self._flash_call(features, edge_info, rel_dist, basis,
+                                    global_feats, pos_emb)
         h = self.heads
         kv_h = self.kv_heads if self.kv_heads is not None else self.heads
         one_headed = kv_h == 1
@@ -243,6 +255,127 @@ class AttentionSE3(nn.Module):
                                 name='to_out')(outputs)
         return outputs
 
+    def _flash_call(self, features: Features, edge_info: EdgeInfo,
+                    rel_dist: jnp.ndarray, basis: Dict[str, jnp.ndarray],
+                    global_feats: Optional[Features],
+                    pos_emb) -> Features:
+        """The streaming-kernel path: same parameters, same function as
+        the unfused path above (parity-gated in tests/test_flash.py and
+        `make flash-smoke`) — but the per-edge basis, the
+        gathered/keyed features, and the score tensor are built per
+        VMEM tile inside kernels.pallas_flash instead of in HBM."""
+        from ..kernels.pallas_flash import flash_attention
+
+        h = self.heads
+        kv_h = self.kv_heads if self.kv_heads is not None else self.heads
+        assert pos_emb is None, \
+            'fuse_pairwise does not support rotary embeddings (they ' \
+            'rewrite k/v per slot before the null/global prepends)'
+        assert not self.linear_proj_keys, \
+            'fuse_pairwise needs conv keys (linear_proj_keys gathers ' \
+            'node-projected keys instead)'
+        assert not self.conv_bf16, \
+            'fuse_pairwise does not apply conv_bf16 (there is no ' \
+            'materialized V2/basis/gathered operand to store bf16 — ' \
+            'the knob would silently do nothing on this path)'
+        neighbor_indices, neighbor_mask, _ = edge_info
+
+        hidden_fiber = self.fiber.to(self.dim_head * h)
+        kv_fiber = self.fiber.to(self.dim_head * kv_h)
+        project_out = not (h == 1 and len(self.fiber.dims) == 1
+                           and self.dim_head == self.fiber.dims[0])
+
+        conv_kwargs = dict(
+            pool=False, self_interaction=False,
+            edge_dim=self.edge_dim or 0,
+            fourier_encode_dist=self.fourier_encode_dist,
+            num_fourier_features=self.rel_dist_num_fourier_features,
+            shared_radial_hidden=True, fuse_pairwise=True,
+            radial_bf16=self.radial_bf16)
+
+        with named_scope('attn_qkv'):
+            queries = LinearSE3(self.fiber, hidden_fiber,
+                                name='to_q')(features)
+            v_prog = ConvSE3(self.fiber, kv_fiber, name='to_v',
+                             backend=self.backend_v, **conv_kwargs)(
+                features, edge_info, rel_dist, basis)
+            k_prog = None
+            if not self.tie_key_values:
+                k_prog = ConvSE3(self.fiber, kv_fiber, name='to_k',
+                                 backend=self.backend_k, **conv_kwargs)(
+                    features, edge_info, rel_dist, basis)
+            if self.attend_self:
+                self_keys = LinearSE3(self.fiber, kv_fiber,
+                                      name='to_self_k')(features)
+                self_values = LinearSE3(self.fiber, kv_fiber,
+                                        name='to_self_v')(features)
+            if global_feats is not None:
+                g_in = Fiber.create(1, self.global_feats_dim)
+                g_out = Fiber.create(1, self.dim_head * kv_h)
+                global_keys = LinearSE3(g_in, g_out,
+                                        name='to_global_k')(global_feats)
+                global_values = LinearSE3(g_in, g_out,
+                                          name='to_global_v')(global_feats)
+
+        sh = basis.get('flash_sh')
+        frames = basis.get('so2')
+        outputs = {}
+        for degree in features.keys():
+            m = to_order(int(degree))
+            Dh = self.dim_head * m
+            b, n = features[degree].shape[:2]
+            q = queries[degree].reshape(b, n, h, Dh)
+
+            # prefix slots, left of the neighbors in the unfused concat
+            # order [global, null, self] — always valid (the unfused
+            # mask left-pads True over them)
+            pre_k, pre_v = [], []
+            if global_feats is not None and degree == '0':
+                g_k, g_v = global_keys['0'], global_values['0']
+                num_g = g_k.shape[1]
+                for t, dst in ((g_k, pre_k), (g_v, pre_v)):
+                    t = t.reshape(b, num_g, kv_h * Dh)[:, None]
+                    dst.append(jnp.broadcast_to(
+                        t, (b, n, num_g, kv_h * Dh)))
+            if self.use_null_kv:
+                null_k = self.param(f'null_k{degree}', nn.initializers.zeros,
+                                    (kv_h, self.dim_head, m), q.dtype)
+                null_v = self.param(f'null_v{degree}', nn.initializers.zeros,
+                                    (kv_h, self.dim_head, m), q.dtype)
+                for t, dst in ((null_k, pre_k), (null_v, pre_v)):
+                    dst.append(jnp.broadcast_to(
+                        t.reshape(1, 1, 1, kv_h * Dh),
+                        (b, n, 1, kv_h * Dh)))
+            if self.attend_self:
+                for t, dst in ((self_keys[degree], pre_k),
+                               (self_values[degree], pre_v)):
+                    dst.append(t.reshape(b, n, 1, kv_h * Dh))
+            prefix_k = jnp.concatenate(pre_k, axis=2) if pre_k else None
+            prefix_v = jnp.concatenate(pre_v, axis=2) if pre_v else None
+
+            xs = tuple(features[str(d_in)]
+                       for d_in, _ in v_prog['pairs'])
+            kwargs = dict(sh=sh, frames=frames,
+                          prefix_k=prefix_k, prefix_v=prefix_v,
+                          pallas=self.pallas,
+                          interpret=self.flash_interpret)
+            if k_prog is not None:
+                kwargs.update(h_k=k_prog['h'], wk=k_prog['w3'][degree],
+                              bk=k_prog['b3'][degree],
+                              arm_k=k_prog['arm'])
+            out = flash_attention(
+                q, xs, neighbor_indices, neighbor_mask, v_prog['h'],
+                v_prog['w3'][degree], v_prog['b3'][degree],
+                pairs=v_prog['pairs'], d_out=int(degree), heads=h,
+                kv_heads=kv_h, scale=self.dim_head ** -0.5,
+                arm_v=v_prog['arm'], **kwargs)
+            outputs[degree] = out.reshape(b, n, h * self.dim_head, m)
+
+        if project_out:
+            outputs = LinearSE3(hidden_fiber, self.fiber,
+                                name='to_out')(outputs)
+        return outputs
+
 
 class OneHeadedKVAttentionSE3(AttentionSE3):
     """Shazeer multi-query attention: one k/v head shared across all query
@@ -276,6 +409,8 @@ class AttentionBlockSE3(nn.Module):
     conv_bf16: bool = False
     backend_v: str = 'dense'
     backend_k: str = 'dense'
+    fuse_pairwise: bool = False
+    flash_interpret: bool = False
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -301,12 +436,15 @@ class AttentionBlockSE3(nn.Module):
                 pallas=self.pallas,
                 pallas_attention=self.pallas_attention,
                 pallas_attention_interpret=self.pallas_attention_interpret,
-                shared_radial_hidden=self.shared_radial_hidden,
+                shared_radial_hidden=(self.shared_radial_hidden
+                                      or self.fuse_pairwise),
                 edge_chunks=self.edge_chunks,
                 fuse_basis=self.fuse_basis,
                 radial_bf16=self.radial_bf16,
                 conv_bf16=self.conv_bf16,
                 pallas_interpret=self.pallas_interpret,
+                fuse_pairwise=self.fuse_pairwise,
+                flash_interpret=self.flash_interpret,
                 name='attn')(out, edge_info, rel_dist, basis, global_feats,
                              pos_emb, mask)
         return residual_se3(out, res)

@@ -597,6 +597,17 @@ class ConvSE3(nn.Module):
     # the basis dict's reserved key (e.g. basis['so2']) and share the
     # dense path's parameter layout.
     backend: str = 'dense'
+    # fuse_pairwise: return the pairwise PROGRAM instead of the
+    # contracted features — {'h': [b, n, k, mid] radial hidden,
+    # 'pairs': ((d_in, c_in), ...), 'w3'/'b3': {str(d_out): grouped
+    # param}} — so the streaming flash-attention kernel
+    # (kernels.pallas_flash) can run the contraction per VMEM tile.
+    # NOTHING is gathered and no basis tensor is consumed: the per-edge
+    # keyed features never exist in HBM. Parameter names/shapes are
+    # IDENTICAL to the shared-radial grouped path (_grouped_pair_params
+    # + the same radial trunk call order), so one checkpoint serves the
+    # fused and unfused attention paths alike.
+    fuse_pairwise: bool = False
 
     def _grouped_pair_params(self, degree_in: int, degree_out: int,
                              mid: int, m_in: int, m_out: int):
@@ -630,6 +641,38 @@ class ConvSE3(nn.Module):
         edge_features = rel_dist_feats
         if edges is not None:
             edge_features = jnp.concatenate((rel_dist_feats, edges), axis=-1)
+
+        if self.fuse_pairwise:
+            # pairwise-program mode (see the field comment): the radial
+            # trunk runs here (per-edge h is the one per-edge tensor the
+            # flash kernel still reads from HBM); gathers and the basis
+            # contraction move inside the streaming kernel
+            assert self.shared_radial_hidden, \
+                'fuse_pairwise requires shared_radial_hidden=True (the ' \
+                'flash kernel consumes the grouped w3/b3 layout)'
+            assert not self.pool and not self.self_interaction, \
+                'fuse_pairwise serves the attention kv path (pool=False)'
+            assert self.backend in ('dense', 'so2'), \
+                f'fuse_pairwise supports the dense/so2 arms, not ' \
+                f'{self.backend!r}'
+            hidden = radial_hidden(
+                edge_features, DEFAULT_MID_DIM,
+                dtype=jnp.bfloat16 if self.radial_bf16 else None)
+            w3s: Dict[str, jnp.ndarray] = {}
+            b3s: Dict[str, jnp.ndarray] = {}
+            for degree_out, m_out in self.fiber_out:
+                ws, bs = [], []
+                for degree_in, m_in in self.fiber_in:
+                    w3, b3 = self._grouped_pair_params(
+                        degree_in, degree_out, hidden.shape[-1], m_in,
+                        m_out)
+                    ws.append(w3)
+                    bs.append(b3)
+                w3s[str(degree_out)] = jnp.concatenate(ws, axis=1)
+                b3s[str(degree_out)] = jnp.concatenate(bs, axis=0)
+            return dict(h=hidden,
+                        pairs=tuple((d, c) for d, c in self.fiber_in),
+                        arm=self.backend, w3=w3s, b3=b3s)
 
         # gather neighbor features once per input degree
         # (exchange_index_select: under the ring branch's exchange scope

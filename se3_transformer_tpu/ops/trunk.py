@@ -84,6 +84,11 @@ class SequentialTrunk(nn.Module):
     # everywhere — ops.conv.CONV_BACKENDS)
     value_backends: Optional[tuple] = None
     key_backends: Optional[tuple] = None
+    # per-block streaming-attention selection (resolved by the model
+    # from its fuse_pairwise spec; None = unfused everywhere). A fused
+    # block routes k/v + attention through kernels.pallas_flash.
+    fused_attention: Optional[tuple] = None
+    flash_interpret: bool = False
 
     @nn.compact
     def __call__(self, x: Features, edge_info, rel_dist, basis,
@@ -126,6 +131,9 @@ class SequentialTrunk(nn.Module):
                 radial_bf16=self.radial_bf16,
                 conv_bf16=self.conv_bf16,
                 pallas_interpret=self.pallas_interpret,
+                fuse_pairwise=(self.fused_attention[i]
+                               if self.fused_attention else False),
+                flash_interpret=self.flash_interpret,
                 name=f'attn_block{i}')(
                     x, edge_info, rel_dist, basis, global_feats, pos_emb,
                     mask)

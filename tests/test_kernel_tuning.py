@@ -91,8 +91,36 @@ def test_promote_roundtrip_changes_pick_and_logs_consult(isolated_cache):
 def test_attention_promote_changes_pick():
     tuning.promote('attention', ATT_FLAGSHIP, (32,))
     assert _pick_block_n(*ATT_FLAGSHIP) == 32
-    # bwd stays heuristic
+    # a FORWARD entry never steers the backward ('attention_bwd' is its
+    # own kind): bwd stays heuristic
     assert _pick_block_n(*ATT_FLAGSHIP, bwd=True) == 64
+
+
+def test_attention_bwd_is_its_own_kind():
+    """ISSUE 11 satellite: the attention backward consults kind
+    'attention_bwd' — the tuner can promote a measured bwd block, and
+    it never leaks into the forward (or the f32 pick from a bf16
+    entry: dtype is threaded)."""
+    tuning.promote('attention_bwd', ATT_FLAGSHIP, (16,))
+    assert _pick_block_n(*ATT_FLAGSHIP, bwd=True) == 16
+    assert _pick_block_n(*ATT_FLAGSHIP) == 128  # fwd untouched
+    # dtype keys the entry
+    tuning.promote('attention_bwd', ATT_FLAGSHIP, (8,), dtype='bfloat16')
+    assert _pick_block_n(*ATT_FLAGSHIP, bwd=True) == 16
+    assert _pick_block_n(*ATT_FLAGSHIP, bwd=True, dtype='bfloat16') == 8
+    # every bwd consult is recorded under its own kind
+    adopted = tuning.consult_summary()['adopted']
+    assert any(c['kernel'] == 'attention_bwd' and c['source'] == 'cache'
+               for c in adopted)
+    assert not any(c['kernel'] == 'attention' for c in adopted)
+
+
+def test_attention_bwd_invalid_entry_degrades_with_warning():
+    tuning.promote('attention_bwd', ATT_FLAGSHIP, (512,))  # bwd-model
+    # inadmissible at this shape (the ~2x row model rejects 512)
+    import pytest as _pytest
+    with _pytest.warns(UserWarning, match='not tile-legal'):
+        assert _pick_block_n(*ATT_FLAGSHIP, bwd=True) == 64
 
 
 def test_bx_and_bxf_are_distinct_kinds():

@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke flash-smoke perf-gate clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke flash-smoke chaos-smoke perf-gate clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -71,6 +71,13 @@ flash-smoke:       ## CPU streaming-attention gate (docs/PERFORMANCE.md "Flash e
 	python scripts/flash_smoke.py --metrics /tmp/flash_smoke.jsonl
 	python scripts/obs_report.py /tmp/flash_smoke.jsonl --validate --require flash --out /tmp/flash_smoke_summary.json
 	python scripts/perf_gate.py /tmp/flash_smoke.jsonl
+
+chaos-smoke:       ## fault-domain gate (docs/ROBUSTNESS.md): seeded replica crashes + latency spikes + a torn latest checkpoint + one rolling swap over 3 CPU replicas — zero lost requests, >=1 observed quarantine->recovery, swap restores the FALLBACK step, schema'd fault records (--require fault), judged by the chaos perf budgets; then the WEAKENED arm (a fault class made droppable) must exit rc==1, proving the zero-lost gate fires
+	rm -f /tmp/chaos_smoke.jsonl
+	python scripts/chaos_smoke.py --metrics /tmp/chaos_smoke.jsonl --out /tmp/chaos_smoke_summary.json
+	python scripts/obs_report.py /tmp/chaos_smoke.jsonl --validate --require fault,serve --out /tmp/chaos_smoke_report.json
+	python scripts/perf_gate.py /tmp/chaos_smoke.jsonl
+	python scripts/chaos_smoke.py --weaken drop >/tmp/chaos_weaken.log 2>&1; test $$? -eq 1 || { echo "chaos-smoke weakened arm did NOT fire with rc=1 — a droppable fault class went undetected; output:"; cat /tmp/chaos_weaken.log; exit 1; }  # rc=1 is the gate FIRING on lost requests; any other rc (crash, argparse) fails loudly with the evidence
 
 perf-gate:         ## committed budgets vs the evidence streams (docs/PERFORMANCE.md "The perf gate"): must PASS on the current tree, then must FIRE on an injected synthetic regression
 	python scripts/perf_gate.py --fresh-cost /tmp/perf_gate_cost.jsonl

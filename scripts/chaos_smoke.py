@@ -1,0 +1,326 @@
+"""Chaos smoke: the single-host fault domain under deterministic fire.
+
+Usage:
+    python scripts/chaos_smoke.py [--replicas 3] [--requests 80]
+        [--buckets 8,16] [--batch-size 2] [--max-wait-ms 10]
+        [--timeout-s 30] [--max-retries 2] [--seed 0]
+        [--swap-at 30] [--ckpt-dir DIR] [--metrics CHAOS.jsonl]
+        [--out SUMMARY.json] [--weaken none|drop]
+
+N CPU replicas serve a mixed-length stream while a seeded
+`faults.FaultInjector` (same seed, same faults) injects:
+
+  * replica crashes   — replica 0's dispatches 2-4 raise, driving its
+    health breaker healthy -> degraded -> QUARANTINED; the router drops
+    it from rotation, redispatches the failed batches onto siblings,
+    and recovers it via exponential-backoff half-open probe traffic;
+  * latency spikes    — every 9th engine run sleeps (the slow-replica
+    case: served, slower, no contract change);
+  * a torn checkpoint — the checkpoint directory's LATEST step is
+    corrupted after its write (`checkpoint_written` corrupt plan); the
+    mid-run rolling weight swap hot-reloads from that directory, so
+    `restore_params` must fall back to the newest VALID step;
+  * instant deadlines — two requests submit with timeout_s=0 and must
+    shed before dispatch with a structured RequestFailed('deadline').
+
+Exit is non-zero unless ALL of:
+  * zero lost requests: every submit resolves answered or structured-
+    error (RequestRejected at the door / RequestFailed after), never
+    silence;
+  * >= 1 quarantine -> recovery transition was OBSERVED (the breaker
+    actually cycled);
+  * the rolling swap completed on every replica FROM THE FALLBACK step
+    (the corrupt latest was skipped — the swap tag names the step);
+  * zero post-warmup compiles (faults must not break the AOT contract);
+  * the telemetry stream (serve + the new `fault` records) is
+    schema-valid.
+
+`--weaken drop` is the injection arm of the `make chaos-smoke` pair: it
+replaces the router's structured-failure choke point with a silent drop
+(and zeroes the retry budget), so failed requests are LOST — the run
+must then exit rc==1, proving the zero-lost gate fires rather than
+decorates. The clean arm must pass AND the weakened arm must fail; any
+other combination fails the make target.
+"""
+import argparse
+import atexit
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from se3_transformer_tpu.utils.compilation_cache import (  # noqa: E402
+    enable_compilation_cache,
+)
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description='seeded fault injection over the multi-replica '
+                    'serving fault domain (CPU)')
+    ap.add_argument('--replicas', type=int, default=3)
+    ap.add_argument('--requests', type=int, default=80)
+    ap.add_argument('--oversize', type=int, default=1)
+    ap.add_argument('--buckets', type=str, default='8,16')
+    ap.add_argument('--batch-size', type=int, default=2)
+    ap.add_argument('--max-wait-ms', type=float, default=10.0)
+    ap.add_argument('--max-queue-depth', type=int, default=256)
+    ap.add_argument('--timeout-s', type=float, default=30.0)
+    ap.add_argument('--max-retries', type=int, default=2)
+    ap.add_argument('--flush-every', type=int, default=8)
+    ap.add_argument('--swap-at', type=int, default=None,
+                    help='rolling swap_from_checkpoint after this many '
+                         'requests (default: requests // 2)')
+    ap.add_argument('--seed', type=int, default=0)
+    ap.add_argument('--ckpt-dir', type=str, default=None,
+                    help='checkpoint dir for the torn-latest swap '
+                         '(default: a fresh temp dir, removed after)')
+    ap.add_argument('--metrics', type=str, default=None)
+    ap.add_argument('--out', type=str, default=None)
+    ap.add_argument('--checkpoint', default=None, help=argparse.SUPPRESS)
+    ap.add_argument('--weaken', choices=('none', 'drop'), default='none',
+                    help="'drop': silently drop after-budget failures "
+                         'instead of resolving them structurally — the '
+                         'zero-lost gate MUST fire (rc 1), proving it '
+                         'is live')
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    enable_compilation_cache()
+    import numpy as np
+
+    from serve import build_module_and_params, request_lengths
+    from se3_transformer_tpu.faults import FaultInjector
+    from se3_transformer_tpu.inference import (
+        AdmissionController, InferenceEngine, RequestRejected,
+    )
+    from se3_transformer_tpu.inference.admission import RequestFailed
+    from se3_transformer_tpu.observability import MetricLogger, PhaseTimer
+    from se3_transformer_tpu.observability.schema import (
+        SchemaError, validate_stream,
+    )
+    from se3_transformer_tpu.serving import (
+        HealthConfig, ReplicaWorker, Router, RouterTelemetry,
+    )
+    from se3_transformer_tpu.training.checkpoint import CheckpointManager
+
+    buckets = tuple(int(b) for b in args.buckets.split(','))
+    swap_at = (args.swap_at if args.swap_at is not None
+               else args.requests // 2)
+    cfg, module, params = build_module_and_params(args, buckets)
+    _, _, swap_params = build_module_and_params(args, buckets,
+                                                seed=args.seed + 1)
+
+    # ---- the fault plan (seeded — same seed, same chaos) ------------- #
+    inj = FaultInjector(seed=args.seed)
+    inj.plan('replica_dispatch', 'exception', match=dict(replica=0),
+             at=(2, 3, 4))               # 3 consecutive -> quarantined
+    inj.plan('engine_run', 'latency', every=9, latency_s=0.03)
+    inj.plan('checkpoint_written', 'corrupt', at=(2,))  # tear the latest
+
+    # ---- a checkpoint dir whose LATEST step is torn ------------------ #
+    ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix='chaos_ckpt_')
+    if args.ckpt_dir is None:
+        # cleanup must survive ANY exit path — a crashed chaos run
+        # must not leak two full param checkpoints into /tmp per run
+        atexit.register(shutil.rmtree, ckpt_dir, ignore_errors=True)
+    mgr = CheckpointManager(ckpt_dir, fault_injector=inj)
+    mgr.save(1, dict(params=swap_params))      # the valid fallback step
+    mgr.save(2, dict(params=params))           # torn by the corrupt plan
+    print(f'checkpoints: step 1 valid, step 2 TORN (latest) '
+          f'in {ckpt_dir}')
+
+    # ---- N replicas, one shared timer, faults wired into every site -- #
+    t0 = time.perf_counter()
+    timer = PhaseTimer()
+    engines = [InferenceEngine(module, params, buckets=buckets,
+                               batch_size=args.batch_size, return_type=1,
+                               timer=timer, fault_injector=inj)
+               for _ in range(args.replicas)]
+    print(f'warmup: {args.replicas} replicas x '
+          f'{len(engines[0].executables)} bucket executables in '
+          f'{time.perf_counter() - t0:.1f}s')
+    workers = [ReplicaWorker(i, e, max_wait_ms=args.max_wait_ms,
+                             fault_injector=inj)
+               for i, e in enumerate(engines)]
+    admission = AdmissionController(max_len=buckets[-1],
+                                    max_queue_depth=args.max_queue_depth)
+    health = HealthConfig(quarantine_after=3, recover_after=2,
+                          probe_backoff_s=0.05, probe_backoff_max_s=2.0)
+    max_retries = 0 if args.weaken == 'drop' else args.max_retries
+
+    ok = True
+    with Router(workers, admission=admission, health=health,
+                max_retries=max_retries,
+                default_timeout_s=args.timeout_s) as router:
+        if args.weaken == 'drop':
+            # THE WEAKENED ARM: a fault class becomes droppable — the
+            # structured-failure choke point is a no-op, so after-
+            # budget failures vanish instead of resolving. The gates
+            # below MUST catch this (rc 1) or they are decoration.
+            print('WEAKENED GATE ARM: after-budget failures are '
+                  'silently dropped (this run must exit 1)')
+            router._fail_request = lambda pending, error: None
+        logger = MetricLogger(args.metrics, run_meta=dict(
+            mode='chaos_smoke', replicas=args.replicas,
+            buckets=list(buckets), batch_size=args.batch_size,
+            seed=args.seed, weaken=args.weaken,
+            dtype=engines[0].dtype_name))
+        telemetry = RouterTelemetry(router, admission, logger)
+        telemetry.arm()
+
+        rng = np.random.RandomState(args.seed)
+        lengths = request_lengths(args, buckets, router.max_len, rng)
+
+        pending, flushed_at, swapped = [], 0, False
+        swap_events = []
+
+        def guarded_submit(length, **kw):
+            """Every submit path shares the rejection guard: a
+            structured RequestRejected (oversize / overload shed) is a
+            GATED outcome, never a harness crash — an uncaught one
+            would make a crash rc indistinguishable from the zero-lost
+            gate firing."""
+            tokens = rng.randint(0, cfg.num_tokens, size=length)
+            coords = rng.normal(size=(length, 3)).astype(np.float32)
+            try:
+                pending.append(router.submit(tokens, coords, **kw))
+            except RequestRejected as e:
+                print(f'rejected: {e.code} {e.detail}')
+                logger.log_record('step', mirror=False,
+                                  step=len(pending),
+                                  rejected=e.to_record())
+        for i, length in enumerate(lengths):
+            if i == swap_at and not swapped:
+                # rolling hot-reload FROM the torn-latest directory:
+                # restore_params must fall back to step 1 (the tag
+                # names the step it restored)
+                swap_events = router.swap_from_checkpoint(ckpt_dir)
+                swapped = True
+                print(f'rolling swap after request {i}: '
+                      f'{len(swap_events)} replicas re-pointed, tag '
+                      f'{swap_events[0]["tag"]!r}')
+            guarded_submit(length)
+            if i in (3, 4):
+                # two already-expired requests: must shed BEFORE any
+                # dispatch with a structured RequestFailed('deadline')
+                guarded_submit(lengths[0], timeout_s=0.0)
+            router.pump()
+            time.sleep(0.002)   # stream pacing: give probe backoffs
+            #                     and latency spikes real time to land
+            if router.batches_dispatched - flushed_at >= args.flush_every:
+                telemetry.flush()
+                flushed_at = router.batches_dispatched
+        # keep probing until the quarantined replica recovered (bounded
+        # — the breaker must be OBSERVED closing, not assumed)
+        probe_rounds = 0
+        while router.health.recoveries == 0 and probe_rounds < 200:
+            probe_rounds += 1
+            time.sleep(0.01)
+            guarded_submit(lengths[0])
+            router.pump()
+        # deadline-drain the stragglers, then close the stream
+        while router.queue_depth:
+            wait = router.next_deadline()
+            if wait:
+                time.sleep(wait)
+            router.pump()
+    # __exit__ -> close(): drained, retries settled, executors down
+    telemetry.flush()
+    fault_rec = telemetry.fault_flush(injector=inj, pending=pending,
+                                      label='chaos_smoke')
+    summary = telemetry.close()
+    logger.close()
+
+    # ---- gates ------------------------------------------------------- #
+    lost = [p.request_id for p in pending if not p.done]
+    if lost:
+        print(f'FAIL: {len(lost)} submitted requests LOST (resolved '
+              f'neither answered nor structured-error): {lost[:10]}')
+        ok = False
+    unstructured = [p.request_id for p in pending
+                    if p.done and p.error is not None
+                    and not isinstance(p.error, RequestFailed)]
+    if unstructured:
+        print(f'FAIL: {len(unstructured)} requests resolved with a RAW '
+              f'error instead of a structured RequestFailed: '
+              f'{unstructured[:10]}')
+        ok = False
+    if router.health.recoveries < 1:
+        print('FAIL: no quarantine -> recovery transition observed — '
+              'the circuit breaker never closed back')
+        ok = False
+    if len(swap_events) != args.replicas:
+        print(f'FAIL: rolling swap incomplete: {len(swap_events)} swap '
+              f'events for {args.replicas} replicas')
+        ok = False
+    elif not swap_events[0]['tag'].endswith('@1'):
+        print(f'FAIL: swap restored tag {swap_events[0]["tag"]!r} — '
+              f'expected the FALLBACK step 1 (the torn latest step 2 '
+              f'must be skipped)')
+        ok = False
+    if telemetry.post_warmup_compiles:
+        print(f'FAIL: {telemetry.post_warmup_compiles} post-warmup '
+              f'compile events — injected faults must not break the '
+              f'AOT contract')
+        ok = False
+    by_site = fault_rec['injections_by_site']
+    for needed in ('replica_dispatch:exception', 'checkpoint_written:'
+                   'corrupt', 'engine_run:latency'):
+        if not by_site.get(needed):
+            print(f'FAIL: planned fault class {needed!r} never fired — '
+                  f'the chaos proved less than it claims')
+            ok = False
+    if router.timeouts < 2:
+        print(f'FAIL: {router.timeouts} deadline timeouts — the two '
+              f'timeout_s=0 submits must shed structurally')
+        ok = False
+    if args.metrics:
+        try:
+            info = validate_stream(args.metrics)
+            print(f'schema ok: {info["records"]} records {info["kinds"]}')
+        except SchemaError as e:
+            print(f'FAIL: telemetry stream invalid: {e}')
+            ok = False
+
+    report = dict(
+        ok=ok,
+        weaken=args.weaken,
+        requests=dict(submitted=len(pending),
+                      answered=sum(1 for p in pending if p.ok),
+                      structured_failures=sum(
+                          1 for p in pending
+                          if p.done and p.error is not None),
+                      lost=len(lost), **admission.snapshot()),
+        injections=fault_rec['injections_by_site'],
+        health=router.health.snapshot(),
+        health_transitions=router.health.transitions,
+        recoveries=router.health.recoveries,
+        retries=router.retries,
+        request_failures=router.request_failures,
+        timeouts=router.timeouts,
+        deadline_sheds=router.deadline_sheds,
+        swap_tag=swap_events[0]['tag'] if swap_events else None,
+        post_warmup_compiles=telemetry.post_warmup_compiles,
+        batches=router.batches_dispatched,
+        request_latency_ms=summary['metrics']['request_latency_ms'],
+    )
+    print(json.dumps(report, indent=2, default=str))
+    if args.out:
+        with open(args.out, 'w') as f:
+            json.dump(report, f, indent=2, default=str)
+        print(f'report -> {args.out}')
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

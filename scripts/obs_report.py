@@ -196,6 +196,35 @@ def _gate_serve(records):
     return True
 
 
+def _gate_fault(records):
+    faults = [r for r in records if r.get('kind') == 'fault']
+    if not faults:
+        print('FAULT GATE: no fault records in the stream (was the run '
+              'chaos-exercised — scripts/chaos_smoke.py?)',
+              file=sys.stderr)
+        return False
+    last = faults[-1]
+    if not last.get('injections_total'):
+        print('FAULT GATE: zero injections in the final fault record — '
+              'a fault record that exercised nothing proves nothing',
+              file=sys.stderr)
+        return False
+    lost = last.get('lost_requests')
+    if lost != 0:
+        print(f'FAULT GATE: lost_requests={lost!r} — every submit must '
+              f'resolve answered-or-structured-error under injected '
+              f'faults (zero-lost contract)', file=sys.stderr)
+        return False
+    print(f"fault gate ok: {len(faults)} fault records, "
+          f"{last['injections_total']} injections, "
+          f"{last.get('recoveries', 0)} quarantine recoveries, "
+          f"{last.get('retries', 0)} retries / "
+          f"{last.get('timeouts', 0)} timeouts / "
+          f"{last.get('request_failures', 0)} structured failures, "
+          f"0 lost", file=sys.stderr)
+    return True
+
+
 def _gate_so2_sweep(records):
     sweeps = [r for r in records if r.get('kind') == 'so2_sweep']
     if not sweeps:
@@ -261,7 +290,8 @@ def _gate_flash(records):
 _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       tune=_gate_tune, cost=_gate_cost,
                       profile=_gate_profile, serve=_gate_serve,
-                      so2_sweep=_gate_so2_sweep, flash=_gate_flash)
+                      so2_sweep=_gate_so2_sweep, flash=_gate_flash,
+                      fault=_gate_fault)
 
 
 def main(argv=None):
@@ -287,7 +317,8 @@ def main(argv=None):
                          'memory; profile: per-scope attribution '
                          'present with its coverage figure; serve: '
                          'per-bucket latency percentiles present and '
-                         'a nonzero answered count) and exits '
+                         'a nonzero answered count; fault: injections '
+                         'present and zero lost requests) and exits '
                          'non-zero on failure')
     # legacy aliases for the unified --require flag (kept: Makefiles and
     # session scripts in the wild still pass them)

@@ -66,9 +66,12 @@ DEFAULT_BUDGETS = os.path.join(REPO, 'PERF_BUDGETS.json')
 # FLASH_AB.jsonl: the banked `make flash-smoke` streaming-attention A/B
 # stream, so the fused arm's step-time + peak-HBM wins and its
 # equivariance gate are judged by a plain `make perf-gate`.
+# CHAOS_SMOKE.jsonl: the banked `make chaos-smoke` fault-domain stream,
+# so the zero-lost-requests contract, the observed quarantine->recovery
+# transition, and the nonzero-injections proof bit are judged too.
 DEFAULT_RECORDS = ('BENCH_r05.json', 'WIDTH_TABLE.jsonl',
                    'SERVE_MULTI.jsonl', 'SO2_SWEEP.jsonl',
-                   'FLASH_AB.jsonl')
+                   'FLASH_AB.jsonl', 'CHAOS_SMOKE.jsonl')
 
 
 # --------------------------------------------------------------------- #

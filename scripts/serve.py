@@ -85,6 +85,16 @@ def parse_args(argv=None):
                          'dispatch — replica executions overlap instead '
                          'of serializing through the submit loop '
                          '(serving.ReplicaWorker async_dispatch)')
+    ap.add_argument('--timeout-s', type=float, default=None,
+                    help='multi-replica only: per-request deadline '
+                         '(submitted_at + timeout); expired requests '
+                         'shed before dispatch and resolve with a '
+                         'structured RequestFailed("deadline")')
+    ap.add_argument('--max-retries', type=int, default=1,
+                    help='multi-replica only: redispatches of a failed '
+                         "batch's requests onto sibling replicas before "
+                         'a structured RequestFailed("retries_'
+                         'exhausted")')
     return ap.parse_args(argv)
 
 
@@ -287,65 +297,70 @@ def serve_multi(args):
                for i, e in enumerate(engines)]
     admission = AdmissionController(max_len=buckets[-1],
                                     max_queue_depth=args.max_queue_depth)
-    router = Router(workers, admission=admission)
+    # the router is a context manager: its dispatch executors shut down
+    # when the block exits, ON ERROR PATHS TOO — a crashed serve loop
+    # must not leak replica threads
+    with Router(workers, admission=admission,
+                max_retries=args.max_retries,
+                default_timeout_s=args.timeout_s) as router:
+        # materialize the swap weights BEFORE arming the compile
+        # watchdog: a real rolling reload restores numpy leaves off the
+        # async-checkpoint path (zero compiles); the smoke's stand-in —
+        # a fresh seeded init — compiles eager init programs, which
+        # must land in the warmup window, not against the AOT contract
+        swap_params = None
+        if args.swap_at is not None:
+            _, _, swap_params = build_module_and_params(
+                args, buckets, seed=args.seed + 1)
+        logger = MetricLogger(args.metrics, run_meta=dict(
+            mode='serve_multi', replicas=args.replicas,
+            buckets=list(buckets), batch_size=args.batch_size,
+            dtype=engines[0].dtype_name))
+        telemetry = RouterTelemetry(router, admission, logger)
+        telemetry.arm()
 
-    # materialize the swap weights BEFORE arming the compile watchdog:
-    # a real rolling reload restores numpy leaves off the async-
-    # checkpoint path (zero compiles); the smoke's stand-in — a fresh
-    # seeded init — compiles eager init programs, which must land in
-    # the warmup window, not against the AOT contract
-    swap_params = None
-    if args.swap_at is not None:
-        _, _, swap_params = build_module_and_params(
-            args, buckets, seed=args.seed + 1)
-    logger = MetricLogger(args.metrics, run_meta=dict(
-        mode='serve_multi', replicas=args.replicas,
-        buckets=list(buckets), batch_size=args.batch_size,
-        dtype=engines[0].dtype_name))
-    telemetry = RouterTelemetry(router, admission, logger)
-    telemetry.arm()
+        # ---- the request stream, with one mid-run rolling swap ------ #
+        rng = np.random.RandomState(args.seed)
+        lengths = request_lengths(args, buckets, router.max_len, rng)
 
-    # ---- the request stream, with one mid-run rolling weight swap --- #
-    rng = np.random.RandomState(args.seed)
-    lengths = request_lengths(args, buckets, router.max_len, rng)
-
-    pending, flushed_at, swapped = [], 0, False
-    for i, length in enumerate(lengths):
-        if args.swap_at is not None and i == args.swap_at and not swapped:
-            # same shapes, new values: the swap must compile NOTHING
-            # and drop NOTHING (the gates below prove both)
-            events = router.swap_weights(swap_params,
-                                         tag=f'seed_{args.seed + 1}')
-            swapped = True
-            print(f'rolling weight swap after request {i}: '
-                  f'{len(events)} replicas swapped, '
-                  f'{sum(e["drained_batches"] for e in events)} partial '
-                  f'batches drained')
-        tokens = rng.randint(0, cfg.num_tokens, size=length)
-        coords = rng.normal(size=(length, 3)).astype(np.float32)
-        try:
-            pending.append(router.submit(tokens, coords))
-        except RequestRejected as e:
-            print(f'rejected: {e.code} {e.detail}')
-            logger.log_record('step', mirror=False, step=len(pending),
-                              rejected=e.to_record())
-        router.pump()
-        if router.batches_dispatched - flushed_at >= args.flush_every:
-            telemetry.flush()
-            flushed_at = router.batches_dispatched
-    # deadline-drain the stragglers, then close the stream
-    while router.queue_depth:
-        wait = router.next_deadline()
-        if wait:
-            time.sleep(wait)
-        elif args.async_dispatch:
-            # async mode: queue_depth includes executor-inflight rows
-            # that no deadline governs — yield instead of spinning
-            time.sleep(0.001)
-        router.pump()
-    # barrier on any async dispatches and shut the executors down
-    # (no-op for synchronous replicas)
-    router.close()
+        pending, flushed_at, swapped = [], 0, False
+        for i, length in enumerate(lengths):
+            if args.swap_at is not None and i == args.swap_at \
+                    and not swapped:
+                # same shapes, new values: the swap must compile
+                # NOTHING and drop NOTHING (the gates below prove both)
+                events = router.swap_weights(swap_params,
+                                             tag=f'seed_{args.seed + 1}')
+                swapped = True
+                print(f'rolling weight swap after request {i}: '
+                      f'{len(events)} replicas swapped, '
+                      f'{sum(e["drained_batches"] for e in events)} '
+                      f'partial batches drained')
+            tokens = rng.randint(0, cfg.num_tokens, size=length)
+            coords = rng.normal(size=(length, 3)).astype(np.float32)
+            try:
+                pending.append(router.submit(tokens, coords))
+            except RequestRejected as e:
+                print(f'rejected: {e.code} {e.detail}')
+                logger.log_record('step', mirror=False,
+                                  step=len(pending),
+                                  rejected=e.to_record())
+            router.pump()
+            if router.batches_dispatched - flushed_at >= args.flush_every:
+                telemetry.flush()
+                flushed_at = router.batches_dispatched
+        # deadline-drain the stragglers, then close the stream
+        while router.queue_depth:
+            wait = router.next_deadline()
+            if wait:
+                time.sleep(wait)
+            elif args.async_dispatch:
+                # async mode: queue_depth includes executor-inflight
+                # rows that no deadline governs — yield, don't spin
+                time.sleep(0.001)
+            router.pump()
+    # __exit__ barriered on any async dispatches and shut the
+    # executors down (no-op for synchronous replicas)
     telemetry.flush()
     summary = telemetry.close()
     logger.close()

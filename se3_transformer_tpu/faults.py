@@ -1,0 +1,194 @@
+"""Deterministic fault injection for the chaos harness.
+
+Robustness claims that were never exercised are fiction: "a crashed
+replica quarantines and recovers", "a torn checkpoint falls back" are
+only true if something actually crashes a replica and tears a
+checkpoint, on demand, reproducibly. `FaultInjector` is that something
+— a seeded, plan-driven injector wired into three sites:
+
+  * `replica_dispatch`  — `ReplicaWorker(fault_injector=...)` fires it
+    before every batch execution (ctx: replica, bucket);
+  * `engine_run`        — `InferenceEngine(fault_injector=...)` fires it
+    inside `run()` (ctx: bucket) — one level deeper, under the timer;
+  * `checkpoint_write` / `checkpoint_written` — `CheckpointManager(
+    fault_injector=...)` fires before/after the durable write (ctx:
+    step, and path on the post-write site, where a `corrupt` plan
+    tears the just-written checkpoint — the preemption-mid-write
+    scenario `restore`'s integrity fallback exists for).
+
+Fault kinds:
+
+  * `exception` — raise `InjectedFault` (walks the exact path a real
+    runner/engine/writer failure walks: dispatch_batch error contract,
+    retry-with-redispatch, health accounting, async-write barriers);
+  * `latency`   — sleep `latency_s` (a slow replica / slow writer);
+  * `corrupt`   — truncate the file (or every file under the dir) named
+    by ctx['path'] to `frac` of its bytes: a torn checkpoint on disk.
+
+Plans are DETERMINISTIC: each plan keeps its own call counter over the
+fires that match its site + ctx filters and triggers on explicit call
+indices (`at=(3, 4)`), a period (`every=5`), or a seeded coin
+(`p=0.1`, from the injector's private `random.Random(seed)` — same
+seed, same faults). Every firing is appended to `injector.injected`
+(JSON-safe), which is the `injections` payload of the schema'd `fault`
+record — the evidence stream `make chaos-smoke` gates on.
+
+    inj = FaultInjector(seed=0)
+    inj.plan('replica_dispatch', 'exception', match=dict(replica=0),
+             at=(2, 3, 4))                  # crash r0's dispatches 2-4
+    inj.plan('engine_run', 'latency', every=7, latency_s=0.05)
+    inj.plan('checkpoint_written', 'corrupt', at=(2,))   # tear ckpt 2
+"""
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import Callable, List, Optional, Sequence
+
+__all__ = ['FaultInjector', 'InjectedFault']
+
+FAULT_KINDS = ('exception', 'latency', 'corrupt')
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected failure (site + plan provenance in the
+    message). Semantically a RuntimeError: consumers must treat it the
+    way they treat a real one — that is the point."""
+
+    def __init__(self, site: str, message: str, **ctx):
+        super().__init__(f'injected fault at {site}: {message}')
+        self.site = site
+        self.ctx = dict(ctx)
+
+
+class _Plan:
+    __slots__ = ('site', 'kind', 'at', 'every', 'p', 'match',
+                 'latency_s', 'frac', 'max_fires', 'calls', 'fires')
+
+    def __init__(self, site: str, kind: str, *,
+                 at: Optional[Sequence[int]] = None,
+                 every: Optional[int] = None,
+                 p: Optional[float] = None,
+                 match: Optional[dict] = None,
+                 latency_s: float = 0.05,
+                 frac: float = 0.5,
+                 max_fires: Optional[int] = None):
+        assert kind in FAULT_KINDS, f'unknown fault kind {kind!r}'
+        assert sum(x is not None for x in (at, every, p)) == 1, \
+            'exactly one of at= / every= / p= selects when a plan fires'
+        self.site = site
+        self.kind = kind
+        self.at = tuple(int(i) for i in at) if at is not None else None
+        self.every = int(every) if every is not None else None
+        self.p = float(p) if p is not None else None
+        self.match = dict(match or {})
+        self.latency_s = float(latency_s)
+        self.frac = float(frac)
+        self.max_fires = max_fires
+        self.calls = 0    # matching fire() calls seen (1-based index)
+        self.fires = 0
+
+    def wants(self, rng: random.Random) -> bool:
+        """Called once per MATCHING fire(); decides and counts."""
+        self.calls += 1
+        if self.max_fires is not None and self.fires >= self.max_fires:
+            return False
+        if self.at is not None:
+            return self.calls in self.at
+        if self.every is not None:
+            return self.calls % self.every == 0
+        return rng.random() < self.p
+
+
+def _truncate(path: str, frac: float):
+    size = os.path.getsize(path)
+    with open(path, 'r+b') as f:
+        f.truncate(max(0, int(size * frac)))
+
+
+def corrupt_path(path: str, frac: float = 0.5) -> List[str]:
+    """Tear a checkpoint on disk: truncate the file — or, for an orbax
+    step directory, every regular file under it — to `frac` of its
+    bytes. Returns the torn paths (for the injection record)."""
+    torn = []
+    if os.path.isdir(path):
+        for root, _, files in os.walk(path):
+            for name in files:
+                p = os.path.join(root, name)
+                _truncate(p, frac)
+                torn.append(p)
+    else:
+        _truncate(path, frac)
+        torn.append(path)
+    return torn
+
+
+class FaultInjector:
+    """Seeded, plan-driven fault injector (module docstring has the
+    full contract). `fire(site, **ctx)` is the instrumentation hook —
+    a no-plan site costs one dict lookup, so leaving the hooks wired in
+    production code is free."""
+
+    def __init__(self, seed: int = 0,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.rng = random.Random(seed)
+        self.seed = int(seed)
+        self.sleep = sleep
+        self._plans: List[_Plan] = []
+        self.injected: List[dict] = []   # JSON-safe firing log
+
+    def plan(self, site: str, kind: str = 'exception', **kw) -> '_Plan':
+        p = _Plan(site, kind, **kw)
+        self._plans.append(p)
+        return p
+
+    # ------------------------------------------------------------------ #
+    def fire(self, site: str, **ctx):
+        """Instrumentation hook: evaluate every plan for `site` whose
+        ctx filters match; act on the first that triggers (raise /
+        sleep / corrupt). Recording happens BEFORE the action, so an
+        injected exception is in the log even though it unwinds."""
+        for plan in self._plans:
+            if plan.site != site:
+                continue
+            if any(ctx.get(k) != v for k, v in plan.match.items()):
+                continue
+            if not plan.wants(self.rng):
+                continue
+            plan.fires += 1
+            event = dict(site=site, kind=plan.kind, call=plan.calls,
+                         **{k: v for k, v in ctx.items()
+                            if isinstance(v, (str, int, float, bool))})
+            self.injected.append(event)
+            if plan.kind == 'latency':
+                event['latency_s'] = plan.latency_s
+                self.sleep(plan.latency_s)
+            elif plan.kind == 'corrupt':
+                path = ctx.get('path')
+                assert path, f'corrupt plan at {site} needs ctx path='
+                event['torn'] = corrupt_path(path, plan.frac)
+            else:
+                raise InjectedFault(
+                    site, f'{plan.kind} (call {plan.calls})', **ctx)
+            # one action per fire: later plans for this site keep
+            # their counters (they were not consulted) and may trigger
+            # on a future call — without this, stacked latency plans
+            # would sleep twice and a latency+exception pair would do
+            # both on one call, violating the documented contract
+            return
+
+    # ------------------------------------------------------------------ #
+    @property
+    def injections_total(self) -> int:
+        return len(self.injected)
+
+    def snapshot(self) -> dict:
+        """The `fault` record's injection payload."""
+        by_site: dict = {}
+        for e in self.injected:
+            key = f"{e['site']}:{e['kind']}"
+            by_site[key] = by_site.get(key, 0) + 1
+        return dict(seed=self.seed, injections=list(self.injected),
+                    injections_total=self.injections_total,
+                    by_site=by_site)

@@ -14,14 +14,28 @@ Two failure modes a bucketed AOT engine must never hit:
 
 `RequestRejected` is an exception AND a record: `to_record()` returns the
 JSON-safe payload that rides the `serve` telemetry stream, so rejections
-are observable, not just raised.
+are observable, not just raised. An overload shed additionally carries a
+machine-readable `retry_after_s` hint (when the controller was built
+with a `retry_hint`, e.g. the router's queue-depth x per-bucket-p50
+estimate) — "retry with backoff" as a number a client can act on, not
+prose.
+
+`RequestFailed` is the TERMINAL sibling for requests that were admitted
+but could not be answered — retry budget exhausted, or deadline expired
+while queued. It resolves a `PendingResult` done-with-structured-error;
+the zero-lost-requests contract (`make chaos-smoke`) is exactly that
+every submit ends answered or `RequestRejected`/`RequestFailed`, never
+silence.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 OVERSIZE = 'oversize'
 OVERLOADED = 'overloaded'
+# RequestFailed codes
+RETRIES_EXHAUSTED = 'retries_exhausted'
+DEADLINE = 'deadline'
 
 
 def fit_bucket(buckets, length: int):
@@ -56,6 +70,46 @@ class RequestRejected(Exception):
         return dict(code=self.code, message=str(self), **self.detail)
 
 
+class RequestFailed(Exception):
+    """Structured TERMINAL failure of an admitted request: `code`
+    ('retries_exhausted' | 'deadline') plus a machine-readable `detail`
+    dict (attempts / deadline / the last underlying error). Set as a
+    `PendingResult.error` — the submitter always gets an answer-shaped
+    object, never a silently dropped request."""
+
+    def __init__(self, code: str, message: str, **detail):
+        super().__init__(message)
+        self.code = code
+        self.detail = dict(detail)
+
+    def to_record(self) -> dict:
+        return dict(code=self.code, message=str(self), **self.detail)
+
+
+def retries_exhausted_error(attempts: int,
+                            cause: Optional[BaseException] = None
+                            ) -> RequestFailed:
+    return RequestFailed(
+        RETRIES_EXHAUSTED,
+        f'request failed on every replica it was dispatched to '
+        f'({attempts} attempt{"s" if attempts != 1 else ""}); the retry '
+        f'budget is spent',
+        attempts=int(attempts),
+        cause=f'{type(cause).__name__}: {cause}' if cause is not None
+        else None)
+
+
+def deadline_error(waited_s: float, timeout_s: float,
+                   attempts: int = 0) -> RequestFailed:
+    return RequestFailed(
+        DEADLINE,
+        f'request deadline expired after {waited_s:.3f}s '
+        f'(timeout {timeout_s:.3f}s) before a dispatch could answer it',
+        waited_s=round(float(waited_s), 4),
+        timeout_s=round(float(timeout_s), 4),
+        attempts=int(attempts))
+
+
 class AdmissionController:
     """Gate requests on length and backlog before they touch the engine.
 
@@ -63,15 +117,20 @@ class AdmissionController:
         ctl.admit(length=700, queue_depth=0)   # raises RequestRejected
 
     Counters (`admitted`, `rejected`) feed the `serve` telemetry record
-    via `snapshot()`.
+    via `snapshot()`. `retry_hint(queue_depth) -> seconds` (optional —
+    the Router wires its queue-depth x per-bucket-p50 estimate in)
+    turns an overload shed's "retry with backoff" into a structured
+    `retry_after_s` the client can actually schedule against.
     """
 
     def __init__(self, max_len: int,
-                 max_queue_depth: Optional[int] = None):
+                 max_queue_depth: Optional[int] = None,
+                 retry_hint: Optional[Callable[[int], float]] = None):
         assert max_len > 0, 'max_len must be positive'
         self.max_len = int(max_len)
         self.max_queue_depth = (int(max_queue_depth)
                                 if max_queue_depth is not None else None)
+        self.retry_hint = retry_hint
         self.admitted = 0
         self.rejected = {OVERSIZE: 0, OVERLOADED: 0}
 
@@ -92,12 +151,18 @@ class AdmissionController:
         if (self.max_queue_depth is not None
                 and queue_depth >= self.max_queue_depth):
             self.rejected[OVERLOADED] += 1
+            detail = dict(queue_depth=int(queue_depth),
+                          max_queue_depth=self.max_queue_depth)
+            hint = ''
+            if self.retry_hint is not None:
+                retry_after = max(0.0, float(self.retry_hint(queue_depth)))
+                detail['retry_after_s'] = round(retry_after, 4)
+                hint = f' (retry_after_s={detail["retry_after_s"]})'
             raise RequestRejected(
                 OVERLOADED,
                 f'queue depth {queue_depth} at the shed threshold '
-                f'({self.max_queue_depth}); retry with backoff',
-                queue_depth=int(queue_depth),
-                max_queue_depth=self.max_queue_depth)
+                f'({self.max_queue_depth}); retry with backoff{hint}',
+                **detail)
         self.admitted += 1
 
     def snapshot(self) -> dict:

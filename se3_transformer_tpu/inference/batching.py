@@ -35,14 +35,22 @@ from .stats import agg_update, agg_zero
 
 class PendingResult:
     """Future-lite: filled in by the flush that dispatches the request.
-    `done=True` with `error` set means the batch's runner raised — the
-    request was consumed but produced no result (`ok` distinguishes)."""
+    `done=True` with `error` set means the request terminally failed —
+    its batch's runner raised (and any retry budget is spent), or its
+    deadline expired while queued (`ok` distinguishes; the error is
+    structured: the runner's exception or a `RequestFailed`).
+
+    `deadline` (absolute, same clock as `submitted_at`; None = no
+    timeout) propagates the caller's `timeout_s` through every queue
+    and redispatch; `attempts` counts dispatches that FAILED under this
+    request (the router's bounded-retry budget)."""
 
     __slots__ = ('request_id', 'length', 'bucket', 'result', 'done',
-                 'error', 'submitted_at', 'completed_at')
+                 'error', 'submitted_at', 'completed_at', 'deadline',
+                 'attempts')
 
     def __init__(self, request_id: int, length: int, bucket: int,
-                 submitted_at: float):
+                 submitted_at: float, deadline: Optional[float] = None):
         self.request_id = request_id
         self.length = length
         self.bucket = bucket
@@ -51,6 +59,8 @@ class PendingResult:
         self.error: Optional[BaseException] = None
         self.submitted_at = submitted_at
         self.completed_at: Optional[float] = None
+        self.deadline = deadline
+        self.attempts = 0
 
     @property
     def ok(self) -> bool:
@@ -62,24 +72,42 @@ class PendingResult:
             return None
         return self.completed_at - self.submitted_at
 
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
+
 
 def dispatch_batch(runner, bucket: int, batch_size: int, tokens, coords,
                    pending: List[PendingResult],
                    completed: List[PendingResult],
                    completed_capacity: int,
-                   clock: Callable[[], float]) -> None:
+                   clock: Callable[[], float],
+                   on_success: Optional[Callable[[int], None]] = None,
+                   on_failure: Optional[Callable] = None) -> None:
     """THE dispatch body — pad, run, resolve — shared by `MicroBatcher`
     (deadline micro-batching) and `serving.ContinuousBatcher`
     (in-flight slots), so the pad/slice/error contract cannot drift
     between them. Pads with `native.loader.pad_to_bucket` (the training
     dataset's padder), slices each result back to its request's true
     rows, and on a raising runner resolves EVERY request of the batch
-    done-with-error (no submitter hangs forever) before re-raising."""
+    done-with-error (no submitter hangs forever) before re-raising.
+
+    `on_success(rows)` / `on_failure(bucket, tokens, coords, pending,
+    exc) -> bool` are the fault-domain hooks (serving.Router wires
+    them): success feeds the replica's health breaker; a failure
+    handler that returns True TAKES OWNERSHIP of the batch's requests
+    (the router's retry queue will redispatch or structurally fail
+    each one) — dispatch_batch then neither resolves nor re-raises.
+    The hooks receive the ORIGINAL per-request arrays, not the padded
+    batch, so a redispatch re-pads for its new bucket slot."""
+    raw_tokens, raw_coords = list(tokens), list(coords)
     tokens, coords, mask = pad_to_bucket(tokens, coords, bucket,
                                          batch_size=batch_size)
     try:
         out = np.asarray(runner(bucket, tokens, coords, mask))
     except Exception as e:
+        if on_failure is not None and \
+                on_failure(bucket, raw_tokens, raw_coords, pending, e):
+            return      # requests taken over by the retry path
         now = clock()
         for p in pending:
             p.error = e
@@ -99,6 +127,8 @@ def dispatch_batch(runner, bucket: int, batch_size: int, tokens, coords,
         completed.append(p)
     if len(completed) > completed_capacity:
         del completed[:-completed_capacity]
+    if on_success is not None:
+        on_success(len(pending))
 
 
 class _BucketQueue:

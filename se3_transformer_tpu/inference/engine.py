@@ -82,9 +82,14 @@ class InferenceEngine:
                  timer: Optional[PhaseTimer] = None,
                  mesh: Optional[Mesh] = None,
                  partition_rules=None,
-                 precompile: bool = True):
+                 precompile: bool = True,
+                 fault_injector=None):
         self.module = module
         self.mesh = mesh
+        # chaos-harness hook (faults.FaultInjector): fires at the top
+        # of run() so injected engine failures/latency walk the real
+        # execution path; None in production costs nothing
+        self.fault_injector = fault_injector
         # rule set name ('replicated'/'tp'/'fsdp') or explicit rule
         # list (parallel.rules); only consulted when a mesh is given
         self.partition_rules = ('tp' if partition_rules is None
@@ -297,6 +302,8 @@ class InferenceEngine:
     def run(self, bucket: int, tokens, coords, mask):
         """Execute one padded fixed-shape batch on the bucket's AOT
         executable; blocks until the result is ready (honest latency)."""
+        if self.fault_injector is not None:
+            self.fault_injector.fire('engine_run', bucket=int(bucket))
         executable = self._executables.get(self._key(bucket))
         if executable is None:
             executable = self.compile_bucket(bucket)

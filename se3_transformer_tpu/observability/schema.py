@@ -81,6 +81,19 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    and equivariance_l2_fused (the streaming kernel must
                    still be equivariant). `make flash-smoke` gates on
                    it and PERF_BUDGETS.json enforces both wins.
+  fault            fault-domain evidence for one chaos/serving run
+                   (serving.RouterTelemetry.fault_flush, exercised by
+                   scripts/chaos_smoke.py): injections (the seeded
+                   FaultInjector's firing log) + injections_total,
+                   health_transitions (per-replica breaker moves) +
+                   recoveries (quarantine -> live count), the retry /
+                   request_failures / timeouts / deadline_sheds
+                   counters, and the load-bearing verdict:
+                   lost_requests (submits that resolved neither
+                   answered nor structured-error — MUST be 0; `make
+                   chaos-smoke` and obs_report --require fault gate
+                   on it, and a fault record with zero injections
+                   proves nothing).
   so2_sweep        per-degree so2-vs-dense contraction A/B
                    (bench.degrees_main via scripts/so2_smoke.py):
                    label, degrees (per-max-degree {so2_step_ms,
@@ -106,7 +119,7 @@ SCHEMA_VERSION = 1
 
 KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
                'serve', 'tune', 'comm', 'cost', 'profile', 'so2_sweep',
-               'flash', 'summary')
+               'flash', 'fault', 'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
@@ -140,6 +153,13 @@ _REQUIRED = {
     # a profile record that cannot say how much device time its scopes
     # account for proves nothing about where the time went
     'profile': ('run_id', 'label', 'scopes', 'device_time_ms', 'coverage'),
+    # lost_requests is the load-bearing field of the fault-domain
+    # contract: a fault record that cannot say whether every submit
+    # resolved answered-or-structured-error proves nothing about
+    # robustness (and injections_total=0 proves nothing was exercised)
+    'fault': ('run_id', 'label', 'injections', 'injections_total',
+              'health_transitions', 'recoveries', 'retries',
+              'request_failures', 'timeouts', 'lost_requests'),
     # equivariance_l2_so2 per degree is the load-bearing field of the
     # backend contract: a sweep record that cannot say the reduced
     # contraction is still equivariant proves nothing about the speedup
@@ -159,6 +179,10 @@ _TUNE_VERDICTS = ('admitted', 'promoted', 'rejected', 'consulted',
 
 _PIPELINE_PREFETCH_REQUIRED = ('depth', 'hits', 'stalls')
 _PIPELINE_VERDICTS = ('producer_bound', 'device_bound', 'balanced')
+
+_HEALTH_STATES = ('healthy', 'degraded', 'quarantined')
+_FAULT_COUNTERS = ('injections_total', 'recoveries', 'retries',
+                   'request_failures', 'timeouts', 'lost_requests')
 
 _COST_SOURCES = ('cost_analysis', 'hlo_estimate', 'unavailable')
 _COST_MEMORY_REQUIRED = ('argument_bytes', 'output_bytes', 'temp_bytes')
@@ -248,6 +272,47 @@ def validate_record(rec: dict, index=None) -> dict:
                     or not isinstance(swaps.get('events'), list):
                 _fail(index, f'serve.swaps must carry an int count and '
                              f'an events list, got {swaps!r}')
+        # fault-domain routing signals (router serve records): optional
+        # but validated when present — item 5's cross-host tier routes
+        # on them, so a malformed signal is worse than a missing one
+        for field in ('retries', 'request_failures', 'timeouts',
+                      'deadline_sheds'):
+            if field in rec:
+                val = rec[field]
+                if not isinstance(val, int) or isinstance(val, bool) \
+                        or val < 0:
+                    _fail(index, f'serve.{field} must be a non-negative '
+                                 f'int, got {val!r}')
+        if 'health' in rec:
+            health = rec['health']
+            if not isinstance(health, dict):
+                _fail(index, 'serve.health must be an object '
+                             '(replica id -> breaker snapshot)')
+            for rid, snap in health.items():
+                if not isinstance(snap, dict) \
+                        or snap.get('state') not in _HEALTH_STATES:
+                    _fail(index, f'serve.health[{rid!r}] must carry a '
+                                 f'state in {_HEALTH_STATES}')
+    if kind == 'fault':
+        for field in ('injections', 'health_transitions'):
+            if not isinstance(rec[field], list):
+                _fail(index, f'fault.{field} must be a list (the '
+                             f'evidence log, empty when clean)')
+        for field in _FAULT_COUNTERS:
+            val = rec[field]
+            if not isinstance(val, int) or isinstance(val, bool) \
+                    or val < 0:
+                _fail(index, f'fault.{field} must be a non-negative '
+                             f'int, got {val!r}')
+        if rec['injections_total'] != len(rec['injections']):
+            _fail(index, f'fault.injections_total='
+                         f'{rec["injections_total"]} contradicts '
+                         f'{len(rec["injections"])} logged injections')
+        for e in rec['health_transitions']:
+            if not isinstance(e, dict) or 'from_state' not in e \
+                    or 'to_state' not in e:
+                _fail(index, f'fault.health_transitions entries must '
+                             f'carry from_state/to_state, got {e!r}')
     if kind == 'tune':
         if rec['verdict'] not in _TUNE_VERDICTS:
             _fail(index, f'tune.verdict {rec["verdict"]!r} not in '

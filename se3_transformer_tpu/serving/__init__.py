@@ -24,9 +24,19 @@ Sharding composes orthogonally: each replica's engine may carry a mesh
 and a `parallel.rules` rule set ('tp' / 'fsdp'), so one large model
 spans chips (TP/FSDP) while DP replicas multiply throughput.
 
-Entry point: `scripts/serve.py --replicas N`; smoke gate:
-`make serve-multi-smoke`.
+  * `health`   — the single-host fault domain (docs/ROBUSTNESS.md):
+    per-replica health state machines (healthy -> degraded ->
+    quarantined with exponential-backoff half-open probes) driven by
+    dispatch outcomes; the router drops quarantined replicas out of
+    rotation, retries failed batches onto siblings (bounded —
+    after-budget failures resolve as structured `RequestFailed`),
+    propagates per-request deadlines, and folds it all into the
+    `serve`/`fault` records. Chaos gate: `make chaos-smoke`.
+
+Entry point: `scripts/serve.py --replicas N`; smoke gates:
+`make serve-multi-smoke`, `make chaos-smoke`.
 """
+from .health import HealthConfig, HealthMonitor, ReplicaHealth  # noqa: F401
 from .replica import ContinuousBatcher, ReplicaWorker  # noqa: F401
 from .router import Router  # noqa: F401
 from .telemetry import RouterTelemetry  # noqa: F401

@@ -28,6 +28,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..inference.admission import deadline_error
 from ..inference.batching import PendingResult, dispatch_batch
 
 
@@ -97,6 +98,18 @@ class ContinuousBatcher:
         self.deadline_flushes = 0        # fallback dispatches
         self.batches_dispatched = 0
         self.rows_dispatched = 0         # real (non-dummy) rows
+        # fault-domain hooks (serving.Router wires them; None = the
+        # original PR 8 semantics, every test of which still holds):
+        # on_success(rows) feeds the health breaker; on_failure(bucket,
+        # tokens, coords, pending, exc) -> True takes ownership of a
+        # failed batch's requests for retry-with-redispatch
+        self.on_success: Optional[Callable[[int], None]] = None
+        self.on_failure: Optional[Callable] = None
+        # per-request deadline accounting: requests resolved with a
+        # structured RequestFailed('deadline') — shed at dispatch time
+        # (deadline_sheds) or expired while waiting in an open slot
+        self.timeouts = 0
+        self.deadline_sheds = 0
         # completed results: drained by telemetry via pop_completed();
         # bounded like MicroBatcher.completed (submitters keep their
         # own PendingResult either way)
@@ -144,8 +157,12 @@ class ContinuousBatcher:
 
     def flush_due(self, now: Optional[float] = None) -> int:
         """Deadline FALLBACK: dispatch every slot whose oldest request
-        has waited `max_wait_ms`. Returns batches dispatched."""
+        has waited `max_wait_ms`. Returns batches dispatched. Expired
+        requests (per-request deadline, not the slot deadline) are
+        resolved with a structured timeout first — they must never
+        consume a batch row."""
         now = self.clock() if now is None else now
+        self.expire_due(now)
         n = 0
         for slot in list(self._slots.values()):
             if slot.pending and \
@@ -154,6 +171,56 @@ class ContinuousBatcher:
                 self.deadline_flushes += 1
                 n += 1
         return n
+
+    def expire_due(self, now: Optional[float] = None) -> int:
+        """Resolve every open-slot request whose own deadline
+        (`PendingResult.deadline`) has passed with a structured
+        `RequestFailed('deadline')` — a request that can no longer be
+        answered in time must not wait for a batch to fill. Returns
+        requests expired."""
+        now = self.clock() if now is None else now
+        n = 0
+        for slot in list(self._slots.values()):
+            n += self._shed_expired(slot, now)
+            if not slot.pending:
+                self._slots.pop(slot.bucket, None)
+        return n
+
+    def _shed_expired(self, slot: _Slot, now: float) -> int:
+        """THE expired-request filter (expire_due and the pre-dispatch
+        shed both route through it, so the two paths cannot drift):
+        drop deadline-expired requests from the slot's parallel lists
+        and resolve them done-with-structured-timeout. Returns how
+        many were shed."""
+        keep = [i for i, p in enumerate(slot.pending)
+                if not p.expired(now)]
+        if len(keep) == len(slot.pending):
+            return 0
+        expired = [p for p in slot.pending if p.expired(now)]
+        slot.tokens = [slot.tokens[i] for i in keep]
+        slot.coords = [slot.coords[i] for i in keep]
+        slot.pending = [slot.pending[i] for i in keep]
+        self._resolve_failed(expired, now=now)
+        return len(expired)
+
+    def _resolve_failed(self, expired: Sequence[PendingResult],
+                        now: Optional[float] = None) -> None:
+        """Resolve timed-out requests done-with-structured-error and
+        publish them to `completed` (the telemetry latency feed sees
+        sheds too)."""
+        now = self.clock() if now is None else now
+        for p in expired:
+            timeout_s = ((p.deadline - p.submitted_at)
+                         if p.deadline is not None else 0.0)
+            p.error = deadline_error(now - p.submitted_at, timeout_s,
+                                     attempts=p.attempts)
+            p.done = True
+            p.completed_at = now
+            self.timeouts += 1
+        with self._completed_lock:
+            self.completed.extend(expired)
+            if len(self.completed) > self._completed_capacity:
+                del self.completed[:-self._completed_capacity]
 
     def drain(self) -> int:
         """Dispatch every non-empty slot (shutdown / weight swap)."""
@@ -200,8 +267,13 @@ class ContinuousBatcher:
         # the slot closes the moment it dispatches; the next admit for
         # this bucket opens a fresh one (on a raising runner the
         # requests resolve done-with-error, never silently re-slotted)
-        pending = slot.pending
         self._slots.pop(slot.bucket, None)
+        # shed-before-dispatch: an expired request must not ride (or
+        # pad out) a batch whose answer it can no longer use
+        self.deadline_sheds += self._shed_expired(slot, self.clock())
+        if not slot.pending:
+            return
+        pending = slot.pending
 
         def run():
             # dispatch_batch resolves into a PRIVATE list; the shared
@@ -214,7 +286,8 @@ class ContinuousBatcher:
                 dispatch_batch(self.runner, slot.bucket, self.batch_size,
                                slot.tokens, slot.coords, pending,
                                done_local, self._completed_capacity,
-                               self.clock)
+                               self.clock, on_success=self.on_success,
+                               on_failure=self.on_failure)
             finally:
                 with self._completed_lock:
                     self.completed.extend(done_local)
@@ -272,14 +345,25 @@ class ReplicaWorker:
     def __init__(self, replica_id: int, engine, *,
                  max_wait_ms: float = 50.0,
                  clock: Callable[[], float] = time.monotonic,
-                 async_dispatch: bool = False):
+                 async_dispatch: bool = False,
+                 fault_injector=None):
         self.id = int(replica_id)
         self.engine = engine
         self.executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f'replica{self.id}') \
             if async_dispatch else None
+        runner = engine.run
+        if fault_injector is not None:
+            # the chaos harness's crash/latency site: fires BEFORE the
+            # engine runs, so an injected exception walks the exact
+            # path a real engine failure walks (dispatch_batch error
+            # contract -> retry/health hooks)
+            def runner(bucket, tokens, coords, mask, _run=engine.run,
+                       _inj=fault_injector, _rid=self.id):
+                _inj.fire('replica_dispatch', replica=_rid, bucket=bucket)
+                return _run(bucket, tokens, coords, mask)
         self.batcher = ContinuousBatcher(
-            engine.run, engine.buckets, engine.batch_size,
+            runner, engine.buckets, engine.batch_size,
             max_wait_ms=max_wait_ms, clock=clock,
             executor=self.executor)
         self.draining = False
@@ -345,5 +429,7 @@ class ReplicaWorker:
                     batches=self.batcher.batches_dispatched,
                     continuous_admissions=self.batcher.continuous_admissions,
                     deadline_flushes=self.batcher.deadline_flushes,
+                    timeouts=self.batcher.timeouts,
+                    deadline_sheds=self.batcher.deadline_sheds,
                     swaps=self.swaps,
                     draining=self.draining)

@@ -20,9 +20,34 @@ throughput with three policies, all deterministic and clock-injectable:
     so a checkpoint hot-reload (`swap_from_checkpoint`, off the
     training-side async-checkpoint path) drops zero requests.
 
+On top of placement, the router owns the single-host FAULT DOMAIN:
+
+  * **replica health + circuit breaking** (`serving.health`) — every
+    dispatch outcome feeds a per-replica breaker (healthy -> degraded
+    -> quarantined); quarantined replicas drop out of least-outstanding
+    rotation and recover through exponential-backoff half-open PROBE
+    traffic (one request at a time), not a restart.
+  * **bounded retry-with-redispatch** — a failed batch's requests are
+    taken over (never resolved-with-raw-error, never silently dropped)
+    and redispatched onto sibling replicas at the next `pump()`; once
+    `max_retries` redispatches have failed, the request resolves with a
+    structured `RequestFailed('retries_exhausted')`.
+  * **deadline propagation** — `submit(..., timeout_s=...)` (or the
+    router-wide `default_timeout_s`) stamps `submitted_at + timeout_s`
+    onto the request; expired requests shed BEFORE dispatch (they never
+    consume a batch row) and resolve with `RequestFailed('deadline')`.
+
+The counters these paths produce (`retries`, `request_failures`,
+`timeouts`, `deadline_sheds`, per-replica health) fold into the
+`serve`/`fault` telemetry records — the routing signals the cross-host
+tier (ROADMAP item 5) consumes.
+
 Structured shedding reuses the PR 2 `AdmissionController` — oversize
 and overload rejections raise `RequestRejected` before touching any
-compiled path, counted for the serve record.
+compiled path, counted for the serve record; the router wires its
+queue-depth x per-bucket-p50 estimate in as the controller's
+`retry_hint`, so overload sheds carry a machine-readable
+`retry_after_s`.
 
 Dispatch is non-blocking when the workers were built with
 `async_dispatch=True` (ReplicaWorker): a filled slot submits its
@@ -30,37 +55,46 @@ execution to the replica's own single-thread executor, so the submit
 loop keeps admitting while engines run and N replicas' executions
 overlap on a multi-chip host. The router's verbs are unchanged —
 `drain`/`swap_weights` barrier per replica, so the rolling-swap
-zero-drop contract holds in either mode; call `close()` at end of
-stream to shut the executors down.
+zero-drop contract holds in either mode; `close()` (or exiting the
+router's `with` block — it is a context manager, so the dispatch
+executors shut down on error paths too) ends the stream.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
 from ..inference.admission import (
-    AdmissionController, fit_bucket, oversize_error,
+    AdmissionController, RequestFailed, fit_bucket, oversize_error,
+    deadline_error, retries_exhausted_error,
 )
 from ..inference.batching import PendingResult
+from .health import QUARANTINED, HealthConfig, HealthMonitor
 from .replica import ReplicaWorker
 
 
 class Router:
-    """Admission + placement + lifecycle over a fleet of replicas.
+    """Admission + placement + fault domain + lifecycle over replicas.
 
         workers = [ReplicaWorker(i, engine_i) for i ...]
-        router = Router(workers, admission=ctl)
-        pending = router.submit(tokens, coords)   # may raise
-        router.pump()                             # deadline fallback
-        router.swap_weights(new_params)           # rolling hot-reload
-        router.drain()                            # end of stream
+        with Router(workers, admission=ctl, max_retries=2,
+                    default_timeout_s=30.0) as router:
+            pending = router.submit(tokens, coords)   # may raise
+            router.pump()             # deadlines, retries, probes
+            router.swap_weights(new_params)           # rolling hot-reload
+            router.drain()                            # end of stream
+        # __exit__ -> close(): executors shut down even on error paths
     """
 
     def __init__(self, workers: Sequence[ReplicaWorker],
                  admission: Optional[AdmissionController] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 health: Optional[HealthConfig] = None,
+                 max_retries: int = 1,
+                 default_timeout_s: Optional[float] = None):
         self.workers: List[ReplicaWorker] = list(workers)
         assert self.workers, 'a router needs at least one replica'
         buckets = {w.engine.buckets for w in self.workers}
@@ -73,11 +107,36 @@ class Router:
         self.clock = clock
         self._next_id = 0
         self.swap_events: List[dict] = []
+        # ---- fault domain ------------------------------------------- #
+        self.health = HealthMonitor([w.id for w in self.workers],
+                                    config=health, clock=clock)
+        self.max_retries = int(max_retries)
+        assert self.max_retries >= 0
+        self.default_timeout_s = default_timeout_s
+        self.retries = 0            # redispatches performed
+        self.request_failures = 0   # structured terminal failures
+        self._retry_timeouts = 0    # deadline failures from the queue
+        # a failed batch's requests land here (from dispatch hooks —
+        # possibly on an executor thread) and are redispatched or
+        # structurally failed by the next pump()/drain() on the serve
+        # loop's thread, so retries never mutate a sibling's batcher
+        # cross-thread
+        self._retry_lock = threading.Lock()
+        self._retry_queue: List[tuple] = []
+        self._failed: List[PendingResult] = []   # for pop_completed
+        self._failed_capacity = 65536
+        for w in self.workers:
+            w.batcher.on_success = self._success_hook(w.id)
+            w.batcher.on_failure = self._failure_hook(w.id)
+        if admission is not None and admission.retry_hint is None:
+            admission.retry_hint = self.retry_after_hint
 
     # ------------------------------------------------------------------ #
     @property
     def queue_depth(self) -> int:
-        return sum(w.outstanding for w in self.workers)
+        with self._retry_lock:
+            retrying = len(self._retry_queue)
+        return sum(w.outstanding for w in self.workers) + retrying
 
     @property
     def continuous_admissions(self) -> int:
@@ -92,28 +151,146 @@ class Router:
         return sum(w.batcher.batches_dispatched for w in self.workers)
 
     @property
+    def timeouts(self) -> int:
+        """Requests resolved RequestFailed('deadline') anywhere: shed or
+        expired in a slot, or expired on the retry queue."""
+        return sum(w.batcher.timeouts
+                   for w in self.workers) + self._retry_timeouts
+
+    @property
+    def deadline_sheds(self) -> int:
+        return sum(w.batcher.deadline_sheds for w in self.workers)
+
+    @property
     def max_len(self) -> int:
         return self.buckets[-1]
 
     def bucket_for(self, length: int) -> Optional[int]:
         return fit_bucket(self.buckets, length)
 
+    def retry_after_hint(self, queue_depth: int) -> float:
+        """Overload-shed backoff hint: queue depth x the per-request
+        drain estimate (mean per-bucket p50 over the shared timer,
+        divided by the batch size). Falls back to 50 ms/request before
+        any latency sample exists."""
+        per_row_s = 0.05
+        timer = getattr(self.workers[0].engine, 'timer', None)
+        if timer is not None:
+            summary = timer.cumulative_summary()
+            p50s = [v.get('p50_ms') for k, v in summary.items()
+                    if k.startswith('bucket_') and v.get('p50_ms')]
+            if p50s:
+                batch = max(1, self.workers[0].engine.batch_size)
+                per_row_s = (sum(p50s) / len(p50s)) / 1e3 / batch
+        return max(1, int(queue_depth)) * per_row_s
+
     # ------------------------------------------------------------------ #
-    def _pick_worker(self) -> ReplicaWorker:
-        """Least-outstanding among non-draining replicas (ties: lowest
-        id — deterministic, and a 1-replica router degenerates to its
-        batcher)."""
+    # fault-domain hooks + the retry queue
+    # ------------------------------------------------------------------ #
+    def _success_hook(self, replica_id: int):
+        def hook(rows: int):
+            self.health.record_success(replica_id)
+        return hook
+
+    def _failure_hook(self, replica_id: int):
+        def hook(bucket, tokens, coords, pending, exc) -> bool:
+            self.health.record_failure(replica_id, exc)
+            with self._retry_lock:
+                for p, t, c in zip(pending, tokens, coords):
+                    self._retry_queue.append((p, t, c, replica_id, exc))
+            return True   # taken over: redispatch or fail structurally
+        return hook
+
+    def _fail_request(self, pending: PendingResult,
+                      error: RequestFailed) -> None:
+        """Terminal structured resolution — the one choke point the
+        zero-lost-requests contract rides (the chaos harness's weakened
+        arm overrides exactly this to prove the gate fires)."""
+        pending.error = error
+        pending.done = True
+        pending.completed_at = self.clock()
+        self.request_failures += 1
+        self._failed.append(pending)
+        if len(self._failed) > self._failed_capacity:
+            del self._failed[:-self._failed_capacity]
+
+    def process_failures(self, now: Optional[float] = None) -> int:
+        """Drain the retry queue: redispatch each failed request onto a
+        sibling (attempts budget and deadline permitting) or resolve it
+        with a structured RequestFailed. Returns requests redispatched.
+        Runs on the serve loop's thread (from pump/drain)."""
+        with self._retry_lock:
+            drained, self._retry_queue = self._retry_queue, []
+        if not drained:
+            return 0
+        now = self.clock() if now is None else now
+        redispatched = 0
+        for p, tokens, coords, failed_on, exc in drained:
+            p.attempts += 1
+            if p.expired(now):
+                timeout_s = ((p.deadline - p.submitted_at)
+                             if p.deadline is not None else 0.0)
+                self._retry_timeouts += 1
+                self._fail_request(p, deadline_error(
+                    now - p.submitted_at, timeout_s, attempts=p.attempts))
+            elif p.attempts > self.max_retries:
+                self._fail_request(
+                    p, retries_exhausted_error(p.attempts, exc))
+            else:
+                self.retries += 1
+                worker = self._pick_worker(exclude=failed_on)
+                worker.admit(p.bucket, tokens, coords, p)
+                redispatched += 1
+        return redispatched
+
+    # ------------------------------------------------------------------ #
+    def _pick_worker(self, exclude: Optional[int] = None) -> ReplicaWorker:
+        """Health-aware least-outstanding placement.
+
+        1. A quarantined replica whose probe backoff elapsed gets THIS
+           request (half-open: exactly one until the outcome lands) —
+           recovery happens via probe traffic, not a restart.
+        2. Otherwise: least-outstanding among non-draining, non-
+           quarantined replicas (degraded ranks after healthy at equal
+           depth; ties break to the lowest id, so an all-healthy fleet
+           behaves exactly as before health existed).
+        3. Last resort (every live replica quarantined): least-
+           outstanding among ALL live replicas — serving through a sick
+           replica beats black-holing the request.
+
+        `exclude` (a replica id) steers retries away from the replica
+        that just failed whenever a sibling exists.
+        """
         live = [w for w in self.workers if not w.draining]
         assert live, 'every replica is draining — rolling swaps take ' \
                      'one replica out at a time, so this is a bug'
-        return min(live, key=lambda w: (w.outstanding, w.id))
+        now = self.clock()
+        for w in live:
+            if w.id != exclude and self.health.probe_due(w.id, now):
+                self.health.begin_probe(w.id)
+                return w
 
-    def submit(self, tokens, coords) -> PendingResult:
+        def rank(w):
+            state = self.health.state(w.id)
+            return (w.outstanding, 0 if state == 'healthy' else 1, w.id)
+
+        routable = [w for w in live
+                    if self.health.state(w.id) != QUARANTINED
+                    and w.id != exclude]
+        if not routable:
+            routable = [w for w in live if w.id != exclude] or live
+        return min(routable, key=rank)
+
+    def submit(self, tokens, coords,
+               timeout_s: Optional[float] = None) -> PendingResult:
         """Admit + place one request; its slot dispatches on fill.
 
         Raises RequestRejected (oversize / overloaded) without touching
         any compiled path; the bucket fit is checked BEFORE admission
-        accounting (same contract as MicroBatcher.submit)."""
+        accounting (same contract as MicroBatcher.submit).
+        `timeout_s` (default: the router's `default_timeout_s`) stamps
+        the request's deadline; the result then either answers in time
+        or resolves with a structured RequestFailed('deadline')."""
         tokens = np.asarray(tokens)
         length = len(tokens)
         bucket = self.bucket_for(length)
@@ -124,16 +301,24 @@ class Router:
         if self.admission is not None:
             self.admission.admit(length, queue_depth=self.queue_depth)
         worker = self._pick_worker()
+        submitted_at = self.clock()
+        timeout_s = (timeout_s if timeout_s is not None
+                     else self.default_timeout_s)
+        deadline = (submitted_at + float(timeout_s)
+                    if timeout_s is not None else None)
         pending = PendingResult(self._next_id, length, bucket,
-                                self.clock())
+                                submitted_at, deadline=deadline)
         self._next_id += 1
         worker.admit(bucket, tokens, coords, pending)
         return pending
 
     def pump(self, now: Optional[float] = None) -> int:
-        """Deadline FALLBACK across the fleet: dispatch every slot whose
-        oldest request hit `max_wait_ms`. Returns batches dispatched."""
+        """The fault-domain heartbeat: redispatch/fail queued retries,
+        expire per-request deadlines, then deadline-FLUSH every slot
+        whose oldest request hit `max_wait_ms`. Returns batches
+        dispatched by the flush fallback."""
         now = self.clock() if now is None else now
+        self.process_failures(now)
         return sum(w.flush_due(now) for w in self.workers)
 
     def next_deadline(self, now: Optional[float] = None) -> Optional[float]:
@@ -145,21 +330,46 @@ class Router:
 
     def drain(self) -> int:
         """Dispatch every partial slot on every replica (end of
-        stream) and barrier on any async dispatches — when it returns,
-        everything admitted has answered. Returns batches dispatched."""
-        return sum(w.drain() for w in self.workers)
+        stream), barrier on any async dispatches, and settle the retry
+        queue — when it returns, everything admitted has answered or
+        failed structurally. Returns batches dispatched.
+
+        Termination is guaranteed: every redispatch increments the
+        request's `attempts`, so a request can bounce at most
+        `max_retries` times before `process_failures` resolves it."""
+        total = 0
+        for _ in range(self.max_retries + 2):
+            total += sum(w.drain() for w in self.workers)
+            if not self.process_failures():
+                with self._retry_lock:
+                    settled = not self._retry_queue
+                if settled and not any(w.batcher.depth
+                                       for w in self.workers):
+                    break
+        return total
 
     def close(self) -> None:
         """Drain, then shut down the replicas' dispatch executors
-        (no-op for synchronous replicas)."""
+        (idempotent; no-op for synchronous replicas)."""
         self.drain()
         for w in self.workers:
             w.close()
+
+    def __enter__(self) -> 'Router':
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # executors must shut down on error paths too — a leaked
+        # replica thread outlives the serve loop otherwise
+        self.close()
+        return False
 
     def pop_completed(self) -> List[PendingResult]:
         done: List[PendingResult] = []
         for w in self.workers:
             done += w.batcher.pop_completed()
+        done += self._failed
+        self._failed = []
         return done
 
     # ------------------------------------------------------------------ #
@@ -175,14 +385,24 @@ class Router:
                 event['tag'] = tag
             self.swap_events.append(event)
             events.append(event)
+            # a drain can strand failed requests on the retry queue
+            # while this replica is out of rotation — settle them now
+            # so the rolling swap itself never delays a retry
+            self.process_failures()
         return events
 
     def swap_from_checkpoint(self, directory: str,
                              step: Optional[int] = None) -> List[dict]:
         """Hot-reload the latest (or a named) training checkpoint into
         every replica — params-only restore off the async-checkpoint
-        path, then the rolling swap."""
+        path (which falls back past a corrupt/partial latest step to
+        the newest valid one), then the rolling swap. The tag names the
+        step actually restored, so a fallback is visible in the swap
+        event."""
         from ..training.checkpoint import CheckpointManager
-        params = CheckpointManager(directory).restore_params(step)
-        tag = f'{directory}@{step if step is not None else "latest"}'
+        mgr = CheckpointManager(directory)
+        params = mgr.restore_params(step)
+        restored = (step if step is not None
+                    else mgr.last_restored_step)
+        tag = f'{directory}@{restored if restored is not None else "latest"}'
         return self.swap_weights(params, tag=tag)

@@ -27,6 +27,10 @@ from ..inference.telemetry import ServeTelemetryBase
 from ..observability import MetricLogger, RetraceWatchdog
 from .router import Router
 
+# the _router_sections subset that also rides every `fault` record
+_FAULT_SECTION_KEYS = ('health', 'retries', 'request_failures',
+                       'timeouts', 'deadline_sheds')
+
 
 class RouterTelemetry(ServeTelemetryBase):
     """Wire a router (+ admission) into the JSONL telemetry stream.
@@ -69,7 +73,10 @@ class RouterTelemetry(ServeTelemetryBase):
                 self.logger.log_record('cost', mirror=False, **body)
 
     def _router_sections(self) -> dict:
-        """The aggregation fields the router adds to both records."""
+        """The aggregation fields the router adds to both records —
+        including the fault-domain signals (per-replica health, retry /
+        timeout / structured-failure counters) the cross-host tier
+        routes on."""
         router = self.router
         return dict(
             replicas={str(w.id): w.snapshot() for w in router.workers},
@@ -77,7 +84,50 @@ class RouterTelemetry(ServeTelemetryBase):
                        events=list(router.swap_events)),
             continuous_admissions=router.continuous_admissions,
             deadline_flushes=router.deadline_flushes,
+            health=router.health.snapshot(),
+            retries=router.retries,
+            request_failures=router.request_failures,
+            timeouts=router.timeouts,
+            deadline_sheds=router.deadline_sheds,
         )
+
+    def fault_flush(self, injector=None, pending=None,
+                    label: str = 'fault') -> dict:
+        """One schema'd `fault` record: what was injected, how the
+        health breakers moved, how the retry/deadline machinery paid it
+        down, and the load-bearing verdict — `lost_requests` (submits
+        in `pending` that resolved neither answered nor structured
+        error; the zero-lost contract `make chaos-smoke` gates on).
+
+        `injector` (a faults.FaultInjector) contributes the injection
+        log; `pending` is the caller's full list of submitted
+        PendingResults (None -> lost accounting limited to what the
+        router can see, i.e. 0 — pass the real list)."""
+        router = self.router
+        pending = list(pending or [])
+        lost = sum(1 for p in pending if not p.done)
+        inj = injector.snapshot() if injector is not None else dict(
+            seed=None, injections=[], injections_total=0, by_site={})
+        # the fault-domain signals come from the SAME assembly the
+        # serve records use — the two record kinds cannot drift
+        sections = self._router_sections()
+        fields = dict(
+            label=label,
+            injections=inj['injections'],
+            injections_total=inj['injections_total'],
+            injections_by_site=inj['by_site'],
+            injector_seed=inj['seed'],
+            health_transitions=router.health.transitions,
+            recoveries=router.health.recoveries,
+            **{k: sections[k] for k in _FAULT_SECTION_KEYS},
+            submitted=len(pending),
+            resolved=sum(1 for p in pending if p.done),
+            answered=sum(1 for p in pending if p.ok),
+            structured_failures=sum(
+                1 for p in pending if p.done and p.error is not None),
+            lost_requests=lost,
+        )
+        return self._emit('fault', fields)
 
     def flush(self) -> dict:
         """One extended `serve` record: aggregate per-bucket window

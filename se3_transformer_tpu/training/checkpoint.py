@@ -6,13 +6,28 @@ checkpoint/restore is the recovery story for preemptible slices, so it is
 first-class here: params + optimizer state + step counter, atomic writes,
 latest-checkpoint discovery, and an async save path (`save_async`) that
 keeps the step loop dispatching while a background thread serializes.
+
+Preemption safety: `latest_step` only ever lists COMPLETED entries (tmp
+debris never matches), but a completed-LOOKING entry can still be torn —
+a preemption between content write and fsync, a truncated blob on a
+non-atomic filesystem, a partially-deleted orbax dir. `restore` /
+`restore_params` therefore verify by construction: when the newest step
+fails to load, they warn LOUDLY and fall back to the next-newest step
+that does (an explicitly named `step=` still fails hard — the caller
+asked for that one). `last_restored_step` says which step actually
+answered. The deterministic `faults.FaultInjector` can tear a
+just-written checkpoint on demand (`fault_injector=` +
+`checkpoint_written` corrupt plans), which is how `make chaos-smoke`
+and the kill-and-resume test prove this path, not just ship it.
 """
 from __future__ import annotations
 
 import os
 import pickle
 import re
+import sys
 import threading
+import warnings
 from typing import Any, Optional
 
 import jax
@@ -76,13 +91,25 @@ class CheckpointManager:
     barriers on the in-flight write and re-raises its failure.
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 fault_injector=None, writer_timeout_s: float = 300.0):
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
         os.makedirs(self.directory, exist_ok=True)
         self._ckptr = ocp.StandardCheckpointer() if _HAS_ORBAX else None
         self._async_thread: Optional[threading.Thread] = None
         self._async_error: Optional[BaseException] = None
+        # chaos-harness hook (faults.FaultInjector): 'checkpoint_write'
+        # fires before the durable write (exception/latency plans — a
+        # dying or slow writer thread), 'checkpoint_written' after it
+        # with the final path (corrupt plans tear the entry on disk —
+        # the preemption-mid-write scenario restore falls back past)
+        self.fault_injector = fault_injector
+        # wait_until_finished bound: a writer thread that outlives this
+        # is never silent — the save-path barrier warns loudly then
+        # keeps waiting (slow != wedged), close paths warn AND raise
+        self.writer_timeout_s = float(writer_timeout_s)
+        self.last_restored_step: Optional[int] = None
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f'step_{step:08d}')
@@ -109,6 +136,8 @@ class CheckpointManager:
         paths): orbax writes to a tmp dir and renames at finalize; the
         pickle fallback writes .pkl.tmp and os.replace()s it — either
         way `latest_step` only ever sees completed checkpoints."""
+        if self.fault_injector is not None:
+            self.fault_injector.fire('checkpoint_write', step=int(step))
         if self._ckptr is not None:
             # hand orbax the jax.Arrays as-is: it writes sharded (even
             # non-fully-addressable multi-host) arrays natively; a
@@ -124,6 +153,9 @@ class CheckpointManager:
             with open(tmp, 'wb') as f:
                 pickle.dump(state, f)
             os.replace(tmp, path)
+        if self.fault_injector is not None:
+            self.fault_injector.fire('checkpoint_written', step=int(step),
+                                     path=path)
 
     def save(self, step: int, state: Any):
         self.wait_until_finished()
@@ -170,33 +202,96 @@ class CheckpointManager:
         t = self._async_thread
         return bool(t is not None and t.is_alive())
 
-    def wait_until_finished(self):
+    def wait_until_finished(self, timeout: Optional[float] = None,
+                            raise_on_timeout: bool = False):
         """Barrier on the in-flight async write (no-op when idle);
-        re-raises a writer-thread failure."""
-        t, self._async_thread = self._async_thread, None
+        re-raises a writer-thread failure. The join warns LOUDLY after
+        `writer_timeout_s` (a wedged writer must never be silent), then
+        — on the save-path barrier — keeps waiting: a slow-but-
+        progressing multi-GB write on a contended filesystem must not
+        crash the training loop for being slow. Close paths
+        (`close()`, `__exit__` with no other exception unwinding) pass
+        `raise_on_timeout=True` instead and raise after the bounded
+        join, keeping the thread reference so a later barrier can
+        still collect a write that eventually lands."""
+        timeout = self.writer_timeout_s if timeout is None else timeout
+        t = self._async_thread
         if t is not None:
-            t.join()
+            t.join(timeout=timeout)
+            if t.is_alive():
+                msg = (f'checkpoint writer thread {t.name!r} still '
+                       f'alive after a {timeout:.1f}s join — the async '
+                       f'write is wedged or very slow (hung/contended '
+                       f'filesystem?); refusing to leak it silently')
+                warnings.warn(msg, RuntimeWarning)
+                if raise_on_timeout:
+                    raise RuntimeError(msg)
+                t.join()     # loud but patient: let a slow write land
+        self._async_thread = None
         err, self._async_error = self._async_error, None
         if err is not None:
             raise RuntimeError('async checkpoint write failed') from err
 
-    def close(self):
-        self.wait_until_finished()
+    def close(self, raise_on_timeout: bool = True):
+        self.wait_until_finished(raise_on_timeout=raise_on_timeout)
 
     def __enter__(self) -> 'CheckpointManager':
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        self.close()
+        # raise on a wedged writer only when nothing else is already
+        # unwinding — the leak report must never mask the real error
+        self.close(raise_on_timeout=exc_type is None)
         return False
+
+    def _fallback_restore(self, restore_one, what: str) -> Any:
+        """Newest-valid-step discovery: try each completed step newest-
+        first; a step that fails to load (torn write, truncated blob,
+        half-deleted orbax dir — the preemption-mid-write outcomes) is
+        skipped with a LOUD warning, never silently. Raises only when
+        no step restores at all."""
+        steps = self.all_steps()
+        if not steps:
+            raise FileNotFoundError(f'no checkpoints in {self.directory}')
+        errors = []
+        for step in reversed(steps):
+            try:
+                state = restore_one(step)
+            except Exception as e:  # noqa: BLE001 - corrupt entries vary
+                errors.append((step, f'{type(e).__name__}: {e}'))
+                warnings.warn(
+                    f'checkpoint step {step} in {self.directory} failed '
+                    f'to {what} ({type(e).__name__}: {e}) — corrupt or '
+                    f'partial (preemption mid-write?); falling back to '
+                    f'the next-newest step', RuntimeWarning)
+                continue
+            self.last_restored_step = step
+            if errors:
+                print(f'checkpoint: restored step {step} after '
+                      f'{len(errors)} corrupt newer step(s): '
+                      f'{[s for s, _ in errors]}', file=sys.stderr)
+            return state
+        raise RuntimeError(
+            f'no restorable checkpoint in {self.directory}: every step '
+            f'failed — {errors}')
 
     def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
         """`like` (optional): a pytree matching the saved state. jax.Array
         leaves restore placed with like's shardings (tp-partitioned
-        training resumes partitioned — no host round trip)."""
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f'no checkpoints in {self.directory}')
+        training resumes partitioned — no host round trip).
+
+        With `step=None` the newest VALID step answers: a corrupt or
+        partial latest entry is warned about and skipped (see
+        `_fallback_restore`; `last_restored_step` says which step
+        loaded). A named `step` fails hard — the caller asked for it."""
+        if step is not None:
+            state = self._restore_step(step, like)
+            self.last_restored_step = int(step)
+            return state
+        return self._fallback_restore(
+            lambda s: self._restore_step(s, like), 'restore')
+
+    def _restore_step(self, step: int, like: Any = None) -> Any:
         if self._ckptr is not None and os.path.isdir(self._step_dir(step)):
             target = None
             if like is not None:
@@ -233,10 +328,21 @@ class CheckpointManager:
         everything but params. Leaves come back as numpy arrays; feed
         them to `InferenceEngine` (which device-puts them once at
         construction) or jax.device_put them yourself.
+
+        Same integrity fallback as `restore`: with `step=None` a
+        corrupt/partial latest entry is skipped (loudly) for the
+        newest step that loads, so a serving hot-reload
+        (`Router.swap_from_checkpoint`) survives a training-side
+        preemption mid-write; a named `step` fails hard.
         """
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f'no checkpoints in {self.directory}')
+        if step is not None:
+            params = self._restore_params_step(step)
+            self.last_restored_step = int(step)
+            return params
+        return self._fallback_restore(self._restore_params_step,
+                                      'restore params from')
+
+    def _restore_params_step(self, step: int) -> Any:
         path = self._step_dir(step)
         if self._ckptr is not None and os.path.isdir(path):
             # tuple-rooted states flatten to string keys '0', '1', ... in

@@ -44,6 +44,7 @@ import itertools
 import queue
 import threading
 import time
+import warnings
 from typing import Any, Callable, Iterable, Iterator, Optional, Union
 
 import jax
@@ -151,6 +152,16 @@ class BatchProducer:
             if item is _DONE:
                 self._exhausted = True
                 self._thread.join(timeout=5)
+                if self._thread.is_alive():
+                    # the sentinel arrived, so the source loop is done —
+                    # a thread still alive here is wedged in teardown;
+                    # say so instead of silently leaking it (close()
+                    # will raise if it is STILL alive then)
+                    warnings.warn(
+                        f'batch-producer thread {self._thread.name!r} '
+                        f'still alive 5s after its end-of-source '
+                        f'sentinel — leaking a wedged thread',
+                        RuntimeWarning)
                 self._raise_or_stop()
             self.gets += 1
             return item
@@ -162,21 +173,40 @@ class BatchProducer:
             ) from self._error
         raise StopIteration
 
-    def close(self):
-        """Idempotent: stop the thread, drain the queue, join."""
+    def close(self, timeout: float = 5.0, raise_on_leak: bool = True):
+        """Idempotent: stop the thread, drain the queue, join.
+
+        A thread that survives the bounded join is a LEAK — most likely
+        the batch source is blocked inside `next()` (an uninterruptible
+        build, a hung filesystem) and will hold its batch memory and a
+        Python thread for the rest of the process. That is never
+        silent: a loud RuntimeWarning always, and a RuntimeError when
+        `raise_on_leak` (the context manager suppresses the raise only
+        while another exception is already propagating, so the original
+        error is never masked)."""
         self._stop.set()
         try:
             while True:
                 self._q.get_nowait()
         except queue.Empty:
             pass
-        self._thread.join(timeout=5)
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            msg = (f'batch-producer thread {self._thread.name!r} still '
+                   f'alive after a {timeout:.1f}s close join — the '
+                   f'batch source is wedged (blocked inside next()?); '
+                   f'the thread and its queued batches are leaking')
+            warnings.warn(msg, RuntimeWarning)
+            if raise_on_leak:
+                raise RuntimeError(msg)
 
     def __enter__(self) -> 'BatchProducer':
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        self.close()
+        # raise on a leaked thread only when nothing else is already
+        # unwinding — a leak report must never mask the real error
+        self.close(raise_on_leak=exc_type is None)
         return False
 
 

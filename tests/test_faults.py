@@ -1,0 +1,328 @@
+"""Fault-domain tests: the deterministic FaultInjector, preemption-safe
+checkpoint restore (corrupt/torn latest step falls back to the newest
+valid one, orbax AND pickle paths, kill-and-resume), and the loud-
+thread-leak contracts (BatchProducer close, checkpoint writer join)."""
+import os
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from se3_transformer_tpu.faults import (
+    FaultInjector, InjectedFault, corrupt_path,
+)
+from se3_transformer_tpu.training.checkpoint import CheckpointManager
+from se3_transformer_tpu.training.pipeline import BatchProducer
+
+
+# --------------------------------------------------------------------- #
+# FaultInjector: deterministic, plan-driven
+# --------------------------------------------------------------------- #
+def test_injector_at_plan_fires_on_exact_calls_and_logs():
+    inj = FaultInjector(seed=0)
+    inj.plan('site', 'exception', at=(2, 4))
+    inj.fire('site')                          # call 1: clean
+    with pytest.raises(InjectedFault, match='site'):
+        inj.fire('site')                      # call 2: fires
+    inj.fire('site')                          # call 3: clean
+    with pytest.raises(InjectedFault):
+        inj.fire('site')                      # call 4: fires
+    inj.fire('site')                          # call 5: clean (exhausted)
+    assert inj.injections_total == 2
+    assert [e['call'] for e in inj.injected] == [2, 4]
+    snap = inj.snapshot()
+    assert snap['by_site'] == {'site:exception': 2}
+    assert snap['seed'] == 0
+
+
+def test_injector_match_filters_and_every_period():
+    inj = FaultInjector(seed=0)
+    inj.plan('dispatch', 'exception', match=dict(replica=0), every=2)
+    # replica 1 never matches: its calls do not advance the plan counter
+    for _ in range(6):
+        inj.fire('dispatch', replica=1)
+    inj.fire('dispatch', replica=0)           # matching call 1
+    with pytest.raises(InjectedFault):
+        inj.fire('dispatch', replica=0)       # matching call 2: fires
+    assert inj.injections_total == 1
+    assert inj.injected[0]['replica'] == 0
+
+
+def test_injector_seeded_probability_is_reproducible():
+    def pattern(seed):
+        inj = FaultInjector(seed=seed)
+        inj.plan('s', 'exception', p=0.5)
+        hits = []
+        for i in range(32):
+            try:
+                inj.fire('s')
+                hits.append(0)
+            except InjectedFault:
+                hits.append(1)
+        return hits
+
+    a, b = pattern(7), pattern(7)
+    assert a == b and 0 < sum(a) < 32
+    assert pattern(8) != a                    # a different seed differs
+
+
+def test_injector_one_action_per_fire():
+    """Multiple plans on one site never stack on a single call: the
+    first triggering plan acts and the fire returns."""
+    slept = []
+    inj = FaultInjector(seed=0, sleep=slept.append)
+    inj.plan('s', 'latency', every=1, latency_s=0.5)
+    inj.plan('s', 'latency', every=1, latency_s=0.25)
+    inj.fire('s')
+    assert slept == [0.5]                     # second plan did NOT act
+    assert inj.injections_total == 1
+
+
+def test_injector_latency_uses_injected_sleep():
+    slept = []
+    inj = FaultInjector(seed=0, sleep=slept.append)
+    inj.plan('run', 'latency', at=(1,), latency_s=0.125)
+    inj.fire('run', bucket=8)
+    assert slept == [0.125]
+    assert inj.injected[0]['kind'] == 'latency'
+    assert inj.injected[0]['latency_s'] == 0.125
+
+
+def test_corrupt_path_truncates_files_and_dirs(tmp_path):
+    f = os.path.join(tmp_path, 'blob.bin')
+    with open(f, 'wb') as fh:
+        fh.write(b'x' * 1000)
+    corrupt_path(f, frac=0.5)
+    assert os.path.getsize(f) == 500
+    d = os.path.join(tmp_path, 'stepdir', 'inner')
+    os.makedirs(d)
+    for name in ('a', 'b'):
+        with open(os.path.join(d, name), 'wb') as fh:
+            fh.write(b'y' * 100)
+    torn = corrupt_path(os.path.join(tmp_path, 'stepdir'), frac=0.25)
+    assert len(torn) == 2
+    assert all(os.path.getsize(p) == 25 for p in torn)
+
+
+def test_injected_dispatch_fault_walks_the_real_error_contract():
+    """An injected replica_dispatch exception resolves the batch done-
+    with-error through dispatch_batch exactly like a real engine
+    failure (the raw-batcher contract the router's retry path builds
+    on)."""
+    from se3_transformer_tpu.serving import ContinuousBatcher
+    from se3_transformer_tpu.inference.batching import PendingResult
+
+    inj = FaultInjector(seed=0)
+    inj.plan('replica_dispatch', 'exception', match=dict(replica=0),
+             at=(1,))
+
+    def runner(bucket, tokens, coords, mask):
+        inj.fire('replica_dispatch', replica=0, bucket=bucket)
+        return np.zeros(tokens.shape + (3,), np.float32)
+
+    cb = ContinuousBatcher(runner, (8,), 1, max_wait_ms=1e9)
+    rng = np.random.RandomState(0)
+    p = PendingResult(0, 3, 8, 0.0)
+    with pytest.raises(InjectedFault):
+        cb.admit(8, rng.randint(0, 8, size=3),
+                 rng.normal(size=(3, 3)).astype(np.float32), p)
+    assert p.done and not p.ok and isinstance(p.error, InjectedFault)
+    # the plan is spent: the next dispatch succeeds (recovery material)
+    p2 = PendingResult(1, 3, 8, 0.0)
+    cb.admit(8, rng.randint(0, 8, size=3),
+             rng.normal(size=(3, 3)).astype(np.float32), p2)
+    assert p2.ok
+
+
+# --------------------------------------------------------------------- #
+# preemption-safe restore: fall back past a corrupt/partial latest step
+# --------------------------------------------------------------------- #
+def _pickle_mgr(tmp_path, name='ck', **kw):
+    mgr = CheckpointManager(os.path.join(tmp_path, name), **kw)
+    mgr._ckptr = None      # force the pickle fallback path
+    return mgr
+
+
+def test_restore_falls_back_past_truncated_pickle(tmp_path):
+    mgr = _pickle_mgr(tmp_path)
+    for step in (1, 2, 3):
+        mgr.save(step, {'x': jnp.full((4,), float(step)), 'step': step})
+    # tear the LATEST entry (preemption mid-write on a non-atomic fs)
+    corrupt_path(mgr._step_dir(3) + '.pkl', frac=0.3)
+    with pytest.warns(RuntimeWarning, match='corrupt or partial'):
+        state = mgr.restore()
+    assert state['step'] == 2
+    np.testing.assert_array_equal(np.asarray(state['x']),
+                                  np.full((4,), 2.0))
+    assert mgr.last_restored_step == 2
+    # an explicitly named step fails HARD — the caller asked for it
+    with pytest.raises(Exception):
+        mgr.restore(step=3)
+
+
+def test_restore_params_falls_back_past_corrupt_orbax_dir(tmp_path):
+    mgr = CheckpointManager(os.path.join(tmp_path, 'ck'))
+    if mgr._ckptr is None:
+        pytest.skip('orbax unavailable in this container')
+    for step, scale in ((1, 1.0), (2, 2.0)):
+        mgr.save(step, dict(params={'w': jnp.full((3,), scale)}))
+    corrupt_path(mgr._step_dir(2), frac=0.2)   # tear every file inside
+    with pytest.warns(RuntimeWarning, match='falling back'):
+        params = mgr.restore_params()
+    np.testing.assert_array_equal(np.asarray(params['w']),
+                                  np.full((3,), 1.0))
+    assert mgr.last_restored_step == 1
+
+
+def test_restore_raises_only_when_no_step_is_valid(tmp_path):
+    mgr = _pickle_mgr(tmp_path)
+    mgr.save(1, {'x': jnp.ones((2,))})
+    corrupt_path(mgr._step_dir(1) + '.pkl', frac=0.2)
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(RuntimeError, match='no restorable'):
+            mgr.restore()
+    with pytest.raises(FileNotFoundError):
+        _pickle_mgr(tmp_path, name='empty').restore()
+
+
+def test_kill_and_resume_past_torn_checkpoint(tmp_path):
+    """The preemption story end to end: a 'training run' checkpoints
+    every step through a manager whose writer tears step 3 on disk
+    (the injector's corrupt plan = the kill mid-write); the resumed run
+    restores the newest VALID step and continues to the same final
+    state a never-killed run reaches."""
+    inj = FaultInjector(seed=0)
+    inj.plan('checkpoint_written', 'corrupt', at=(3,), frac=0.4)
+    mgr = _pickle_mgr(tmp_path, fault_injector=inj)
+
+    def train_step(state):
+        return dict(w=state['w'] + 1.0, step=state['step'] + 1)
+
+    state = dict(w=jnp.zeros((4,)), step=0)
+    for _ in range(3):                     # steps 1..3; 3 lands TORN
+        state = train_step(state)
+        mgr.save(state['step'], state)
+    assert inj.injections_total == 1       # the kill happened
+    assert mgr.latest_step() == 3          # and looks completed on disk
+
+    resumed_mgr = _pickle_mgr(tmp_path)    # the restarted process
+    with pytest.warns(RuntimeWarning, match='corrupt or partial'):
+        resumed = resumed_mgr.restore()
+    assert resumed['step'] == 2            # newest VALID step
+    while resumed['step'] < 5:             # resume and keep training
+        resumed = train_step(resumed)
+        resumed_mgr.save(resumed['step'], resumed)
+    np.testing.assert_array_equal(np.asarray(resumed['w']),
+                                  np.full((4,), 5.0))
+    assert resumed_mgr.restore()['step'] == 5   # clean run's end state
+
+
+def test_save_async_with_injected_writer_crash_surfaces_at_barrier(
+        tmp_path):
+    inj = FaultInjector(seed=0)
+    inj.plan('checkpoint_write', 'exception', at=(1,))
+    mgr = _pickle_mgr(tmp_path, fault_injector=inj)
+    mgr.save_async(1, {'x': jnp.ones((2,))})
+    with pytest.raises(RuntimeError, match='async checkpoint write'):
+        mgr.wait_until_finished()
+    mgr.save(2, {'x': jnp.ones((2,))})     # manager usable again
+    assert mgr.latest_step() == 2
+
+
+# --------------------------------------------------------------------- #
+# loud thread leaks: bounded joins that warn AND raise
+# --------------------------------------------------------------------- #
+def test_checkpoint_writer_join_timeout_is_loud(tmp_path):
+    """Close paths warn AND raise on a wedged writer (keeping the
+    thread ref so a later barrier can still collect a write that
+    eventually lands)."""
+    mgr = _pickle_mgr(tmp_path, writer_timeout_s=0.1)
+    gate = threading.Event()
+    inner = mgr._write_state
+
+    def gated_write(step, state):
+        assert gate.wait(timeout=30)
+        inner(step, state)
+
+    mgr._write_state = gated_write
+    mgr.save_async(1, {'x': jnp.ones((2,))})
+    with pytest.warns(RuntimeWarning, match='still alive'):
+        with pytest.raises(RuntimeError, match='wedged'):
+            mgr.close()
+    # the thread reference was KEPT: once the writer unwedges, the next
+    # barrier collects it and the checkpoint is durable
+    gate.set()
+    mgr.wait_until_finished(timeout=30)
+    assert mgr.latest_step() == 1
+
+
+def test_checkpoint_save_barrier_warns_but_waits_for_a_slow_write(
+        tmp_path):
+    """The save-path barrier must not crash training for a write that
+    is merely SLOW: it warns loudly at the bound, then keeps waiting
+    and collects the landed checkpoint."""
+    mgr = _pickle_mgr(tmp_path, writer_timeout_s=0.05)
+    gate = threading.Event()
+    inner = mgr._write_state
+
+    def slow_write(step, state):
+        assert gate.wait(timeout=30)
+        inner(step, state)
+
+    mgr._write_state = slow_write
+    mgr.save_async(1, {'x': jnp.ones((2,))})
+    threading.Timer(0.3, gate.set).start()   # the write lands late
+    with pytest.warns(RuntimeWarning, match='still alive'):
+        mgr.wait_until_finished()            # patient: returns clean
+    assert mgr.latest_step() == 1
+
+
+def test_batch_producer_close_leak_warns_and_raises():
+    release = threading.Event()
+
+    def blocked_source():
+        yield 1
+        release.wait()                     # wedged inside next()
+        yield 2
+
+    bp = BatchProducer(blocked_source(), name='leaky-producer')
+    try:
+        assert next(bp) == 1
+        with pytest.warns(RuntimeWarning, match='wedged'):
+            with pytest.raises(RuntimeError, match='leaky-producer'):
+                bp.close(timeout=0.2)
+    finally:
+        release.set()
+    bp._thread.join(timeout=5)
+    assert not bp._thread.is_alive()
+
+
+def test_batch_producer_exit_never_masks_the_original_error():
+    """__exit__ on a wedged producer warns but must NOT replace an
+    exception already unwinding with its own leak RuntimeError."""
+    release = threading.Event()
+
+    def blocked_source():
+        yield 1
+        release.wait()
+        yield 2
+
+    try:
+        with pytest.warns(RuntimeWarning, match='wedged'):
+            with pytest.raises(ValueError, match='original'):
+                with BatchProducer(blocked_source(), capacity=1,
+                                   name='masked-producer') as bp:
+                    bp.close = lambda **kw: BatchProducer.close(
+                        bp, timeout=0.2, **kw)
+                    assert next(bp) == 1
+                    raise ValueError('original')
+    finally:
+        release.set()
+
+
+def test_batch_producer_clean_close_stays_silent(recwarn):
+    with BatchProducer(iter([{'a': 1}, {'a': 2}])) as bp:
+        assert next(bp)['a'] == 1
+    assert not [w for w in recwarn.list
+                if issubclass(w.category, RuntimeWarning)]

@@ -337,6 +337,302 @@ def test_async_runner_error_surfaces_at_the_barrier():
 
 
 # --------------------------------------------------------------------- #
+# fault domain: health breaker, retry-with-redispatch, deadlines
+# --------------------------------------------------------------------- #
+class _FlakyEngine(_FakeEngine):
+    """Engine whose next `fail_next` run() calls raise (then heal)."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.fail_next = 0
+
+    def run(self, bucket, tokens, coords, mask):
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            self.calls.append((bucket, 'FAIL'))
+            raise RuntimeError('replica runner exploded')
+        return super().run(bucket, tokens, coords, mask)
+
+
+def _health_router(n=2, batch_size=1, max_retries=1, timeout_s=None,
+                   health=None, max_queue_depth=None):
+    from se3_transformer_tpu.observability import PhaseTimer
+    from se3_transformer_tpu.serving import HealthConfig
+    clock = _Clock()
+    timer = PhaseTimer()
+    engines = [_FlakyEngine(buckets=(4, 8), batch_size=batch_size)
+               for _ in range(n)]
+    for e in engines:
+        e.timer = timer
+    workers = [ReplicaWorker(i, e, max_wait_ms=10.0, clock=clock)
+               for i, e in enumerate(engines)]
+    ctl = AdmissionController(max_len=8, max_queue_depth=max_queue_depth)
+    health = health if health is not None else HealthConfig(
+        degrade_after=1, quarantine_after=2, recover_after=1,
+        probe_backoff_s=5.0)
+    router = Router(workers, admission=ctl, clock=clock, health=health,
+                    max_retries=max_retries, default_timeout_s=timeout_s)
+    return router, engines, clock, ctl
+
+
+def test_health_state_machine_transitions_and_backoff():
+    from se3_transformer_tpu.serving import HealthConfig, HealthMonitor
+    clock = _Clock()
+    mon = HealthMonitor([0], HealthConfig(
+        degrade_after=1, quarantine_after=3, recover_after=2,
+        probe_backoff_s=1.0, probe_backoff_max_s=3.0), clock=clock)
+    assert mon.state(0) == 'healthy'
+    mon.record_failure(0, RuntimeError('x'))
+    assert mon.state(0) == 'degraded'
+    mon.record_success(0)
+    mon.record_success(0)                     # recover_after=2
+    assert mon.state(0) == 'healthy'
+    for _ in range(3):
+        mon.record_failure(0)
+    assert mon.state(0) == 'quarantined'
+    assert not mon.probe_due(0, clock())      # backoff not elapsed
+    clock.t += 1.5
+    assert mon.probe_due(0, clock())
+    mon.begin_probe(0)
+    assert not mon.probe_due(0, clock())      # half-open: ONE in flight
+    mon.record_failure(0)                     # failed probe: backoff x2
+    assert mon.state(0) == 'quarantined'
+    clock.t += 1.5
+    assert not mon.probe_due(0, clock())      # 2.0s backoff now
+    clock.t += 1.0
+    assert mon.probe_due(0, clock())
+    mon.begin_probe(0)
+    mon.record_success(0)                     # probe success -> degraded
+    assert mon.state(0) == 'degraded'
+    mon.record_success(0)
+    mon.record_success(0)
+    assert mon.state(0) == 'healthy'
+    assert mon.recoveries == 1
+    kinds = [(e['from_state'], e['to_state']) for e in mon.transitions]
+    assert ('quarantined', 'degraded') in kinds
+    assert mon[0].snapshot()['state'] == 'healthy'
+
+
+def test_abandoned_probe_rearms_instead_of_pinning_quarantine():
+    """A probe whose outcome never lands (its request was deadline-
+    shed before the batch ran) must not pin probe_inflight forever —
+    after probe_timeout_s the breaker re-arms and the replica can be
+    probed again."""
+    from se3_transformer_tpu.serving import HealthConfig, HealthMonitor
+    clock = _Clock()
+    mon = HealthMonitor([0], HealthConfig(
+        degrade_after=1, quarantine_after=1, recover_after=1,
+        probe_backoff_s=1.0, probe_timeout_s=10.0), clock=clock)
+    mon.record_failure(0)
+    assert mon.state(0) == 'quarantined'
+    clock.t += 1.5
+    assert mon.probe_due(0, clock())
+    mon.begin_probe(0)
+    assert not mon.probe_due(0, clock())      # half-open: in flight
+    clock.t += 5.0                            # outcome never arrives...
+    assert not mon.probe_due(0, clock())
+    clock.t += 6.0                            # ...past probe_timeout_s
+    assert mon.probe_due(0, clock())          # abandoned + re-armed
+    mon.begin_probe(0)
+    mon.record_success(0)
+    assert mon.state(0) == 'healthy'
+    assert mon.recoveries == 1
+
+
+def test_failed_batch_redispatches_to_sibling_and_succeeds():
+    """The retry tentpole: a failed dispatch's requests are taken over
+    (NOT resolved-with-raw-error), redispatched onto the sibling at the
+    next pump, and answer normally — the submitter never sees the
+    crash."""
+    router, engines, clock, _ = _health_router(n=2, max_retries=1)
+    engines[0].fail_next = 1
+    rng = np.random.RandomState(0)
+    p = router.submit(*_request(rng, 3))      # batch_size=1: dispatches
+    assert not p.done                         # taken over, not errored
+    assert router.queue_depth == 1            # waiting on the retry queue
+    assert router.pump() == 0
+    assert p.done and p.ok                    # answered by the sibling
+    assert p.attempts == 1
+    assert router.retries == 1
+    assert router.health[0].failures_total == 1
+    assert ('FAIL' == engines[0].calls[0][1]
+            and engines[1].calls)             # r0 failed, r1 answered
+
+
+def test_retries_exhausted_resolves_structured_never_silent():
+    from se3_transformer_tpu.inference.admission import RequestFailed
+    router, engines, clock, _ = _health_router(n=2, max_retries=1)
+    engines[0].fail_next = 5
+    engines[1].fail_next = 5
+    rng = np.random.RandomState(0)
+    p = router.submit(*_request(rng, 3))
+    router.pump()                             # retry #1 fails too
+    router.pump()                             # budget spent -> resolve
+    assert p.done and not p.ok
+    assert isinstance(p.error, RequestFailed)
+    assert p.error.code == 'retries_exhausted'
+    assert p.error.detail['attempts'] == 2
+    assert router.request_failures == 1
+    done = router.pop_completed()             # telemetry sees it too
+    assert any(r.request_id == p.request_id for r in done)
+
+
+def test_quarantined_replica_leaves_rotation_and_recovers_via_probe():
+    """The circuit breaker end to end: consecutive failures quarantine
+    replica 0 (traffic routes around it), the backoff elapses, ONE
+    probe request routes into it, succeeds, and the replica returns to
+    rotation — recovery via traffic, not a restart."""
+    from se3_transformer_tpu.serving import HealthConfig
+    router, engines, clock, _ = _health_router(
+        n=2, max_retries=2, health=HealthConfig(
+            degrade_after=1, quarantine_after=1, recover_after=1,
+            probe_backoff_s=5.0))
+    engines[0].fail_next = 1                  # one failure quarantines
+    rng = np.random.RandomState(0)
+    ps = [router.submit(*_request(rng, 3)) for _ in range(2)]
+    router.pump()
+    router.pump()
+    assert all(p.done and p.ok for p in ps)   # retried onto r1
+    assert router.health.state(0) == 'quarantined'
+    n_r0 = len(engines[0].calls)
+    for _ in range(3):                        # backoff NOT elapsed:
+        router.submit(*_request(rng, 3))      # nothing routes to r0
+    assert len(engines[0].calls) == n_r0
+    clock.t += 6.0                            # probe_backoff_s=5.0
+    probe = router.submit(*_request(rng, 3))  # THE half-open probe
+    assert probe.done and probe.ok
+    assert len(engines[0].calls) == n_r0 + 1
+    assert router.health.state(0) == 'healthy'   # recover_after=1
+    assert router.health.recoveries == 1
+    before = len(engines[0].calls)
+    router.submit(*_request(rng, 3))          # back in rotation
+    router.submit(*_request(rng, 3))
+    assert len(engines[0].calls) > before
+
+
+def test_all_quarantined_still_serves_best_effort():
+    router, engines, clock, _ = _health_router(n=1, max_retries=0)
+    engines[0].fail_next = 2
+    rng = np.random.RandomState(0)
+    for _ in range(2):
+        router.submit(*_request(rng, 3))
+    router.pump()
+    assert router.health.state(0) == 'quarantined'
+    p = router.submit(*_request(rng, 3))      # last resort: still routed
+    assert p.done and p.ok
+
+
+def test_deadline_expires_queued_request_with_structured_timeout():
+    from se3_transformer_tpu.inference.admission import RequestFailed
+    router, engines, clock, _ = _health_router(n=1, batch_size=2)
+    rng = np.random.RandomState(0)
+    p = router.submit(*_request(rng, 3), timeout_s=0.5)
+    assert not p.done                         # waiting in a half slot
+    clock.t += 0.6                            # past the deadline,
+    router.pump()                             # which beats max_wait
+    assert p.done and not p.ok
+    assert isinstance(p.error, RequestFailed)
+    assert p.error.code == 'deadline'
+    assert p.error.detail['timeout_s'] == 0.5
+    assert router.timeouts == 1
+    assert not engines[0].calls               # never consumed a dispatch
+
+
+def test_expired_request_sheds_before_dispatch_not_inside_a_batch():
+    router, engines, clock, _ = _health_router(n=1, batch_size=2)
+    rng = np.random.RandomState(0)
+    p1 = router.submit(*_request(rng, 3), timeout_s=0.2)
+    clock.t += 0.3                            # p1 expires in the slot
+    p2 = router.submit(*_request(rng, 4))     # fills -> dispatch NOW
+    assert p2.done and p2.ok                  # answered
+    assert p1.done and not p1.ok              # shed structurally
+    assert p1.error.code == 'deadline'
+    assert router.deadline_sheds == 1
+    assert engines[0].calls                   # the batch still ran (p2)
+
+
+def test_default_timeout_propagates_from_router():
+    router, engines, clock, _ = _health_router(n=1, batch_size=2,
+                                               timeout_s=1.0)
+    rng = np.random.RandomState(0)
+    p = router.submit(*_request(rng, 3))
+    assert p.deadline == pytest.approx(clock() + 1.0)
+    explicit = router.submit(*_request(rng, 4), timeout_s=9.0)
+    assert explicit.deadline == pytest.approx(clock() + 9.0)
+
+
+def test_overload_shed_carries_retry_after_hint():
+    router, engines, clock, ctl = _health_router(n=1, batch_size=4,
+                                                 max_queue_depth=2)
+    rng = np.random.RandomState(0)
+    router.submit(*_request(rng, 3))
+    router.submit(*_request(rng, 3))
+    with pytest.raises(RequestRejected) as e:
+        router.submit(*_request(rng, 3))
+    assert e.value.code == 'overloaded'
+    # the hint is wired by the Router (queue depth x per-bucket p50
+    # estimate; 50 ms/request before any sample exists)
+    assert e.value.detail['retry_after_s'] == pytest.approx(0.1)
+    assert ctl.retry_hint == router.retry_after_hint
+
+
+def test_router_context_manager_closes_on_error_paths():
+    events = []
+    router, engines, clock, _ = _health_router(n=2)
+    for w in router.workers:
+        orig = w.close
+        w.close = (lambda _orig=orig, _id=w.id:
+                   (events.append(_id), _orig())[1])
+    with pytest.raises(ValueError, match='serve loop crashed'):
+        with router:
+            rng = np.random.RandomState(0)
+            router.submit(*_request(rng, 3))
+            raise ValueError('serve loop crashed')
+    assert events == [0, 1]                   # executors shut down
+
+
+# --------------------------------------------------------------------- #
+# the PR 10 foundation the retry tentpole builds on (satellite): an
+# async runner error resolves the WHOLE batch done-with-error, and the
+# SAME requests succeed when redispatched to a healthy replica
+# --------------------------------------------------------------------- #
+def test_async_batch_error_then_redispatch_of_same_requests_succeeds():
+    from concurrent.futures import ThreadPoolExecutor
+
+    class _Boom(Exception):
+        pass
+
+    def exploding(bucket, tokens, coords, mask):
+        raise _Boom('device OOM')
+
+    clock = _Clock()
+    ex = ThreadPoolExecutor(max_workers=1)
+    bad = ContinuousBatcher(exploding, (8,), 2, max_wait_ms=1e9,
+                            clock=clock, executor=ex)
+    rng = np.random.RandomState(0)
+    reqs = [_request(rng, 3), _request(rng, 4)]
+    ps = [PendingResult(i, len(t), 8, clock())
+          for i, (t, c) in enumerate(reqs)]
+    for (t, c), p in zip(reqs, ps):
+        bad.admit(8, t, c, p)                 # fills -> async dispatch
+    with pytest.raises(_Boom):
+        bad.wait()
+    assert all(p.done and not p.ok and isinstance(p.error, _Boom)
+               for p in ps)                   # WHOLE batch done-with-error
+    ex.shutdown(wait=True)
+
+    healthy = _FakeEngine(buckets=(8,), batch_size=2)
+    good = ContinuousBatcher(healthy.run, (8,), 2, max_wait_ms=1e9,
+                             clock=clock)
+    retried = [PendingResult(10 + i, len(t), 8, clock())
+               for i, (t, c) in enumerate(reqs)]
+    for (t, c), p in zip(reqs, retried):      # the SAME request payloads
+        good.admit(8, t, c, p)
+    assert all(p.done and p.ok for p in retried)
+    np.testing.assert_array_equal(retried[0].result[:, 0], [0, 1, 2])
+
+
+# --------------------------------------------------------------------- #
 # telemetry: the extended serve record
 # --------------------------------------------------------------------- #
 def test_router_telemetry_emits_extended_serve_record():
@@ -385,6 +681,66 @@ def test_serve_schema_validates_extension_fields():
         validate_record(dict(base, replicas={'0': dict(served=1)}))
     with pytest.raises(SchemaError, match='swaps'):
         validate_record(dict(base, swaps=dict(count=1)))
+    # fault-domain extension fields: validated when present
+    validate_record(dict(base, retries=2, timeouts=0,
+                         health={'0': dict(state='quarantined')}))
+    with pytest.raises(SchemaError, match='retries'):
+        validate_record(dict(base, retries=-1))
+    with pytest.raises(SchemaError, match='health'):
+        validate_record(dict(base, health={'0': dict(state='on fire')}))
+
+
+def test_fault_schema_load_bearing_fields():
+    base = dict(kind='fault', run_id='r', label='chaos',
+                injections=[dict(site='replica_dispatch',
+                                 kind='exception', call=2)],
+                injections_total=1,
+                health_transitions=[dict(replica=0, t=1.0,
+                                         from_state='healthy',
+                                         to_state='degraded',
+                                         reason='failures')],
+                recoveries=0, retries=1, request_failures=0,
+                timeouts=0, lost_requests=0)
+    validate_record(dict(base))
+    for field in ('lost_requests', 'injections', 'recoveries',
+                  'retries', 'injections_total'):
+        broken = dict(base)
+        broken.pop(field)
+        with pytest.raises(SchemaError, match='missing'):
+            validate_record(broken)
+    with pytest.raises(SchemaError, match='lost_requests'):
+        validate_record(dict(base, lost_requests=-1))
+    with pytest.raises(SchemaError, match='contradicts'):
+        validate_record(dict(base, injections_total=5))
+    with pytest.raises(SchemaError, match='from_state'):
+        validate_record(dict(base, health_transitions=[dict(replica=0)]))
+
+
+def test_router_telemetry_fault_flush_is_schema_valid():
+    from se3_transformer_tpu.faults import FaultInjector, InjectedFault
+    router, engines, clock, ctl = _health_router(n=2, max_retries=1)
+    tele = RouterTelemetry(router, ctl)
+    tele.arm()
+    inj = FaultInjector(seed=3)
+    inj.plan('unit_site', 'exception', at=(1,))
+    with pytest.raises(InjectedFault):
+        inj.fire('unit_site')
+    engines[0].fail_next = 1
+    rng = np.random.RandomState(0)
+    pending = [router.submit(*_request(rng, 3), timeout_s=30.0)]
+    router.pump()                              # retried onto the sibling
+    rec = tele.fault_flush(injector=inj, pending=pending, label='unit')
+    validate_record(dict(rec, kind='fault', run_id='t'))
+    assert rec['lost_requests'] == 0
+    assert rec['retries'] == 1
+    assert rec['injections_total'] == 1
+    assert rec['submitted'] == 1 and rec['answered'] == 1
+    assert rec['health']['0']['failures'] == 1
+    # a serve flush carries the fault-domain routing signals too
+    serve = tele.flush()
+    assert serve['retries'] == 1
+    assert serve['health']['0']['state'] in ('healthy', 'degraded')
+    validate_record(dict(serve, kind='serve', run_id='t'))
 
 
 # --------------------------------------------------------------------- #

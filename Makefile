@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke flash-smoke chaos-smoke perf-gate clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke flash-smoke chaos-smoke quant-smoke perf-gate clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -78,6 +78,12 @@ chaos-smoke:       ## fault-domain gate (docs/ROBUSTNESS.md): seeded replica cra
 	python scripts/obs_report.py /tmp/chaos_smoke.jsonl --validate --require fault,serve --out /tmp/chaos_smoke_report.json
 	python scripts/perf_gate.py /tmp/chaos_smoke.jsonl
 	python scripts/chaos_smoke.py --weaken drop >/tmp/chaos_weaken.log 2>&1; test $$? -eq 1 || { echo "chaos-smoke weakened arm did NOT fire with rc=1 — a droppable fault class went undetected; output:"; cat /tmp/chaos_weaken.log; exit 1; }  # rc=1 is the gate FIRING on lost requests; any other rc (crash, argparse) fails loudly with the evidence
+
+quant-smoke:       ## CPU quantized-serving gate (docs/PERFORMANCE.md "Quantized serving"): fp32 + int8-mix AOT engines from ONE param tree — implementation parity <=1e-4 (padded+unpadded, vs the fp32 reference of the same quantized weights), equivariance-L2 <=1e-4 at degrees 2/4, argument-bytes <=0.6x fp32 off the cost ledger, schema'd quant_ab record banked and judged by the committed quant perf budgets
+	rm -f /tmp/quant_smoke.jsonl
+	python scripts/quant_smoke.py --metrics /tmp/quant_smoke.jsonl
+	python scripts/obs_report.py /tmp/quant_smoke.jsonl --validate --require quant_ab --out /tmp/quant_smoke_summary.json
+	python scripts/perf_gate.py /tmp/quant_smoke.jsonl
 
 perf-gate:         ## committed budgets vs the evidence streams (docs/PERFORMANCE.md "The perf gate"): must PASS on the current tree, then must FIRE on an injected synthetic regression
 	python scripts/perf_gate.py --fresh-cost /tmp/perf_gate_cost.jsonl

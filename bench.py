@@ -978,6 +978,207 @@ def _flash_global_ab(n: int = 192, steps: int = 3):
             / max(out['streaming']['peak_hbm_bytes'], 1), 3))
 
 
+def quant_main(mix: str = 'int8_mix', steps: int = 5,
+               buckets=(12, 24), batch_size: int = 2,
+               eq_degrees=(2, 4)):
+    """`python bench.py --quant [int8_mix|bf16|fp8_mix]`: fp32-vs-
+    quantized-mix serving A/B on the CPU toy engines (the ROADMAP
+    item 3 acceptance harness).
+
+    Builds THREE AOT engines from ONE seeded param tree — fp32, the
+    quantized mix (restore-time quantization: the fp32 tree never
+    lands on device), and the fp32 REFERENCE of the same quantized
+    weights (dequantized host-side) — and measures engine.run latency
+    per bucket in alternating best-of-3 windows. Three claims land as
+    record fields, not prose:
+
+      * argument_bytes_ratio — quantized/fp32 argument bytes off each
+        bucket's PR 6 cost ledger (the per-replica memory claim;
+        budget ceiling 0.6);
+      * parity_max_abs — quantized engine vs the fp32 reference OF THE
+        SAME QUANTIZED WEIGHTS, padded AND unpadded rows (the serving
+        implementation must add nothing beyond quantization itself;
+        gated at the repo-wide 1e-4 bar). The error vs the RAW fp32
+        engine is quant_error_max_abs — the accuracy tradeoff a mix
+        buys its memory with, banked per record (an absolute 1e-4
+        there is mathematically unreachable for any int8 weight grid:
+        per-channel rounding alone is ~0.4% relative);
+      * equivariance_l2 — worst-case over feats models at
+        `eq_degrees`, quantized params (weight-only quantization must
+        preserve equivariance to roundoff).
+
+    Prints ONE bench-shaped JSON line; scripts/quant_smoke.py wraps
+    the payload into the schema'd `quant_ab` record and
+    PERF_BUDGETS.json enforces ratio + parity + equivariance. Never
+    compared against the RECORD anchors: different program."""
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+    import numpy as np
+
+    from se3_transformer_tpu import quant
+    from se3_transformer_tpu.inference import InferenceEngine
+    from se3_transformer_tpu.models.se3_transformer import (
+        SE3TransformerModule,
+    )
+    from se3_transformer_tpu.native.loader import chain_adjacency
+    from se3_transformer_tpu.training.denoise import DenoiseConfig
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    from se3_transformer_tpu.utils.validation import equivariance_l2
+
+    enable_compilation_cache()
+    buckets = tuple(int(b) for b in buckets)
+    rng = np.random.RandomState(0)
+    cfg = DenoiseConfig(num_tokens=24, dim=8, dim_head=8, heads=2,
+                        depth=2, num_degrees=2, max_sparse_neighbors=4)
+    module = cfg.build_module()
+    L = buckets[0]
+    params = jax.jit(module.init, static_argnames=('return_type',))(
+        jax.random.PRNGKey(0),
+        jnp.asarray(rng.randint(0, cfg.num_tokens, size=(1, L))),
+        jnp.asarray(rng.normal(size=(1, L, 3)).astype(np.float32)),
+        mask=jnp.ones((1, L), bool),
+        adj_mat=jnp.asarray(chain_adjacency(L)),
+        return_type=1)['params']
+    host_params = jax.tree_util.tree_map(np.asarray, params)
+
+    qtree, quant_report = quant.quantize_params(host_params, mix)
+    # the fp32 reference OF THE QUANTIZED WEIGHTS: dequantize (and
+    # upcast the bf16 casts) host-side — the implementation-parity
+    # oracle every fused epilogue must match
+    ref_tree = jax.tree_util.tree_map(
+        lambda x: quant.dequantize(x)
+        if isinstance(x, quant.QuantTensor)
+        else (np.asarray(x, np.float32)
+              if getattr(x, 'dtype', None) == jnp.bfloat16 else x),
+        qtree, is_leaf=lambda x: isinstance(x, quant.QuantTensor))
+
+    engines = {
+        'fp32': InferenceEngine(module, host_params, buckets=buckets,
+                                batch_size=batch_size),
+        'quant': InferenceEngine(module, host_params, buckets=buckets,
+                                 batch_size=batch_size, precision=mix),
+        'ref': InferenceEngine(module, ref_tree, buckets=buckets,
+                               batch_size=batch_size),
+    }
+
+    # one padded + one unpadded request set per bucket (fixed across
+    # arms so the comparison is input-identical)
+    requests = {}
+    for b in buckets:
+        full = (rng.randint(0, cfg.num_tokens, size=b),
+                rng.normal(size=(b, 3)).astype(np.float32))
+        short_len = max(1, b - 3)
+        short = (rng.randint(0, cfg.num_tokens, size=short_len),
+                 rng.normal(size=(short_len, 3)).astype(np.float32))
+        requests[b] = (full, short)
+
+    outputs = {arm: {} for arm in engines}
+    for arm, engine in engines.items():
+        for b, (full, short) in requests.items():
+            outputs[arm][b] = (np.asarray(engine.predict(*full)),
+                               np.asarray(engine.predict(*short)))
+    parity = max(float(np.abs(outputs['quant'][b][i]
+                              - outputs['ref'][b][i]).max())
+                 for b in buckets for i in (0, 1))
+    quant_error = max(float(np.abs(outputs['quant'][b][i]
+                                   - outputs['fp32'][b][i]).max())
+                      for b in buckets for i in (0, 1))
+
+    # ALTERNATING windows per bucket (the tune_kernels A/B-pair
+    # discipline): host-load drift hits both arms equally
+    per_bucket = {b: {'fp32': None, 'quant': None} for b in buckets}
+    from se3_transformer_tpu.native.loader import pad_to_bucket
+    for _ in range(3):
+        for arm in ('fp32', 'quant'):
+            engine = engines[arm]
+            for b in buckets:
+                tok, crd = requests[b][0]
+                t, c, m = pad_to_bucket([tok], [crd], b,
+                                        batch_size=batch_size)
+                t0 = time.monotonic()
+                for _ in range(steps):
+                    out = engine.run(b, t, c, m)
+                jax.block_until_ready(out)
+                dt = (time.monotonic() - t0) / steps
+                best = per_bucket[b][arm]
+                if best is None or dt < best:
+                    per_bucket[b][arm] = dt
+
+    bucket_entries = {}
+    for b in buckets:
+        f_ms = per_bucket[b]['fp32'] * 1e3
+        q_ms = per_bucket[b]['quant'] * 1e3
+        bucket_entries[str(b)] = dict(
+            fp32_ms=round(f_ms, 3), quant_ms=round(q_ms, 3),
+            quant_vs_fp32=round(f_ms / q_ms, 3))
+
+    # the memory claim off the cost ledger: argument bytes of the
+    # LARGEST bucket's executable, per arm (params dominate; the
+    # request arrays are identical between arms)
+    top = buckets[-1]
+    costs = {arm: engines[arm].cost_payloads[engines[arm]._key(top)]
+             for arm in ('fp32', 'quant')}
+    arg_fp32 = costs['fp32']['memory']['argument_bytes']
+    arg_quant = costs['quant']['memory']['argument_bytes']
+
+    # equivariance at the swept degrees: feats models, quantized params
+    eq_by_degree = {}
+    n, k, dim = 64, 8, 8
+    feats = jnp.asarray(rng.normal(size=(1, n, dim)), jnp.float32)
+    coors = jnp.asarray(np.cumsum(rng.normal(size=(1, n, 3)), axis=1),
+                        jnp.float32)
+    mask = jnp.ones((1, n), bool)
+    for d in eq_degrees:
+        mod = SE3TransformerModule(
+            dim=dim, depth=1, num_degrees=d + 1, output_degrees=2,
+            reduce_dim_out=True, attend_self=True, num_neighbors=k,
+            heads=2, dim_head=8, num_conv_layers=2, tie_key_values=True)
+        dparams = jax.jit(mod.init, static_argnames=('return_type',))(
+            jax.random.PRNGKey(0), feats, coors, mask=mask,
+            return_type=1)['params']
+        dq, _ = quant.quantize_params(
+            jax.tree_util.tree_map(np.asarray, dparams), mix)
+        eq_by_degree[str(d)] = equivariance_l2(mod, dq, feats, coors,
+                                               mask)
+
+    record = {
+        'metric': f'quant_ab_{mix}(dim={cfg.dim},depth={cfg.depth},'
+                  f'buckets={",".join(str(b) for b in buckets)},'
+                  f'backend=cpu)',
+        'value': bucket_entries[str(top)]['quant_vs_fp32'],
+        'unit': 'quant_vs_fp32_step_ratio',
+        'vs_baseline': 1.0,     # own-program A/B; anchors don't apply
+        'mode': 'quant_ab',
+        'timing': 'best-of-3-alternating',
+        'mix': quant_report['mix'],
+        'buckets': bucket_entries,
+        'argument_bytes_fp32': arg_fp32,
+        'argument_bytes_quant': arg_quant,
+        'argument_bytes_ratio': round(arg_quant / max(arg_fp32, 1), 4),
+        'params_bytes_ratio': quant_report['bytes_ratio'],
+        'quant_report': quant_report,
+        'parity_max_abs': parity,
+        'quant_error_max_abs': quant_error,
+        'equivariance_l2': max(eq_by_degree.values()),
+        'equivariance_by_degree': eq_by_degree,
+        'cost': {arm: dict(body) for arm, body in costs.items()},
+    }
+    if os.environ.get('SE3_TPU_CODE_REV'):
+        record['code_rev'] = os.environ['SE3_TPU_CODE_REV']
+    for arm in ('fp32', 'quant'):
+        print(f"{arm}: {bucket_entries[str(top)][f'{arm}_ms']} ms/step "
+              f"@ bucket {top}, argument bytes "
+              f"{costs[arm]['memory']['argument_bytes']}",
+              file=sys.stderr)
+    print(f'impl parity {parity:.2e}, quant error {quant_error:.2e}, '
+          f'worst eq {record["equivariance_l2"]:.2e}', file=sys.stderr)
+    print(json.dumps(record))
+    return record
+
+
 def degrees_main(degrees, dense_max: int = 4, steps: int = 5):
     """`python bench.py --degrees 2,4,6`: per-degree so2-vs-dense A/B on
     the CPU toy bench (the ROADMAP item 2 acceptance harness).
@@ -1111,6 +1312,18 @@ if __name__ == '__main__':
         if '--steps' in sys.argv[1:]:
             _steps = int(sys.argv[sys.argv.index('--steps') + 1])
         flash_main(steps=_steps)
+        sys.exit(0)
+    if '--quant' in sys.argv[1:]:
+        # CPU A/B harness (no device probe, like --degrees): fp32 vs a
+        # quantized precision mix over the serving engines, flags
+        # parsed before jax initializes its backends
+        _i = sys.argv.index('--quant')
+        _mix = sys.argv[_i + 1] if len(sys.argv) > _i + 1 and \
+            not sys.argv[_i + 1].startswith('--') else 'int8_mix'
+        _steps = 5
+        if '--steps' in sys.argv[1:]:
+            _steps = int(sys.argv[sys.argv.index('--steps') + 1])
+        quant_main(mix=_mix, steps=_steps)
         sys.exit(0)
     if '--degrees' in sys.argv[1:]:
         # CPU A/B harness (no device probe, like --ring): per-degree

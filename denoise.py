@@ -90,7 +90,10 @@ def main():
         trainer.init()
         state = ckpt.restore(like=(trainer.params, trainer.opt_state,
                                    trainer.step_count))
-        trainer.params, trainer.opt_state, trainer.step_count = state
+        # re-places under the trainer's sharding config (fsdp/tp): a
+        # resumed run's opt state lands back in its shards, not
+        # replicated until the first step
+        trainer.restore(state)
         print(f'resumed from step {trainer.step_count}')
 
     import dataclasses

@@ -287,11 +287,46 @@ def _gate_flash(records):
     return True
 
 
+def _gate_quant_ab(records):
+    recs = [r for r in records if r.get('kind') == 'quant_ab']
+    if not recs:
+        print('QUANT GATE: no quant_ab records in the stream (was '
+              'scripts/quant_smoke.py / bench.py --quant run?)',
+              file=sys.stderr)
+        return False
+    last = recs[-1]
+    parity = last.get('parity_max_abs')
+    if not isinstance(parity, (int, float)) or parity >= 1e-4:
+        print(f'QUANT GATE: implementation parity {parity!r} >= 1e-4 '
+              f'(or missing) — the quantized serving path (fused '
+              f'dequant epilogues / kernels / padding) added error '
+              f'beyond quantization itself', file=sys.stderr)
+        return False
+    eq = last.get('equivariance_l2')
+    if not isinstance(eq, (int, float)) or eq >= 1e-4:
+        print(f'QUANT GATE: quantized equivariance L2 {eq!r} >= 1e-4 '
+              f'(or missing) — weight-only quantization must preserve '
+              f'equivariance', file=sys.stderr)
+        return False
+    ratio = last.get('argument_bytes_ratio')
+    if not isinstance(ratio, (int, float)) or ratio <= 0:
+        print(f'QUANT GATE: degenerate argument_bytes_ratio {ratio!r} — '
+              f'the record proves no memory claim', file=sys.stderr)
+        return False
+    print(f"quant gate ok: {len(recs)} quant_ab records, mix "
+          f"{last.get('mix')!r}, argument-bytes ratio {ratio}, impl "
+          f"parity {parity:.2e}, quant error "
+          f"{last.get('quant_error_max_abs')}, eq {eq:.2e} (the ratio "
+          f"ceiling itself is enforced by scripts/perf_gate.py)",
+          file=sys.stderr)
+    return True
+
+
 _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       tune=_gate_tune, cost=_gate_cost,
                       profile=_gate_profile, serve=_gate_serve,
                       so2_sweep=_gate_so2_sweep, flash=_gate_flash,
-                      fault=_gate_fault)
+                      fault=_gate_fault, quant_ab=_gate_quant_ab)
 
 
 def main(argv=None):

@@ -69,9 +69,13 @@ DEFAULT_BUDGETS = os.path.join(REPO, 'PERF_BUDGETS.json')
 # CHAOS_SMOKE.jsonl: the banked `make chaos-smoke` fault-domain stream,
 # so the zero-lost-requests contract, the observed quarantine->recovery
 # transition, and the nonzero-injections proof bit are judged too.
+# QUANT_AB.jsonl: the banked `make quant-smoke` fp32-vs-int8-mix serving
+# A/B, so the argument-bytes ceiling, the implementation-parity gate,
+# and the quantized equivariance gate are judged too.
 DEFAULT_RECORDS = ('BENCH_r05.json', 'WIDTH_TABLE.jsonl',
                    'SERVE_MULTI.jsonl', 'SO2_SWEEP.jsonl',
-                   'FLASH_AB.jsonl', 'CHAOS_SMOKE.jsonl')
+                   'FLASH_AB.jsonl', 'CHAOS_SMOKE.jsonl',
+                   'QUANT_AB.jsonl')
 
 
 # --------------------------------------------------------------------- #

@@ -62,6 +62,15 @@ def parse_args(argv=None):
                     help='emit a serve record every N dispatched batches')
     ap.add_argument('--bf16', action='store_true',
                     help='bf16 activation path (coords cast in, f32 out)')
+    ap.add_argument('--precision', type=str, default=None,
+                    help='weight-precision mix (quant.rules: fp32 / '
+                         'bf16 / int8_mix / fp8_mix). Params quantize '
+                         'at restore time — the fp32 tree never lands '
+                         'on device. With --replicas N, a comma list '
+                         'builds a HETEROGENEOUS fleet (cycled across '
+                         'replicas, e.g. "fp32,int8_mix"); rolling '
+                         'swaps re-quantize per replica at its own mix '
+                         '(zero drops, zero recompiles)')
     ap.add_argument('--checkpoint', type=str, default=None,
                     help='CheckpointManager directory; params-only '
                          'restore (optimizer state is never read)')
@@ -96,6 +105,18 @@ def parse_args(argv=None):
                          'a structured RequestFailed("retries_'
                          'exhausted")')
     return ap.parse_args(argv)
+
+
+def precision_mixes(args):
+    """The per-replica precision list: None -> fp32 everywhere; a
+    single mix applies to every replica; a comma list cycles."""
+    if not args.precision:
+        return [None] * max(args.replicas, 1)
+    mixes = [m.strip() or None for m in args.precision.split(',')]
+    if args.replicas <= 1 and len(mixes) > 1:
+        raise SystemExit('--precision got a comma list but --replicas '
+                         'is 1 — heterogeneous mixes need a fleet')
+    return [mixes[i % len(mixes)] for i in range(max(args.replicas, 1))]
 
 
 def build_module_and_params(args, buckets, seed=None):
@@ -170,11 +191,12 @@ def main(argv=None):
     t0 = time.perf_counter()
     engine = InferenceEngine(
         module, params, buckets=buckets, batch_size=args.batch_size,
-        return_type=1,
+        return_type=1, precision=precision_mixes(args)[0],
         activation_dtype=jnp.bfloat16 if args.bf16 else None)
     print(f'warmup: compiled {len(engine.executables)} bucket '
           f'executables in {time.perf_counter() - t0:.1f}s '
-          f'({engine.compile_seconds})')
+          f'({engine.compile_seconds}, precision '
+          f'{engine.precision_name})')
 
     admission = AdmissionController(max_len=engine.max_len,
                                     max_queue_depth=args.max_queue_depth)
@@ -184,7 +206,7 @@ def main(argv=None):
                            admission=admission)
     logger = MetricLogger(args.metrics, run_meta=dict(
         mode='serve', buckets=list(buckets), batch_size=args.batch_size,
-        dtype=engine.dtype_name))
+        dtype=engine.dtype_name, precision=engine.precision_name))
     telemetry = ServeTelemetry(engine, batcher, admission, logger)
     telemetry.arm()
 
@@ -283,14 +305,16 @@ def serve_multi(args):
     # per-bucket SLO surface), every bucket AOT-compiled per replica --- #
     t0 = time.perf_counter()
     timer = PhaseTimer()
+    mixes = precision_mixes(args)
     engines = [InferenceEngine(
         module, params, buckets=buckets, batch_size=args.batch_size,
-        return_type=1, timer=timer,
+        return_type=1, timer=timer, precision=mixes[i],
         activation_dtype=jnp.bfloat16 if args.bf16 else None)
-        for _ in range(args.replicas)]
+        for i in range(args.replicas)]
     print(f'warmup: {args.replicas} replicas x '
           f'{len(engines[0].executables)} bucket executables in '
-          f'{time.perf_counter() - t0:.1f}s')
+          f'{time.perf_counter() - t0:.1f}s (precision mixes '
+          f'{[e.precision_name for e in engines]})')
 
     workers = [ReplicaWorker(i, e, max_wait_ms=args.max_wait_ms,
                              async_dispatch=args.async_dispatch)
@@ -315,7 +339,8 @@ def serve_multi(args):
         logger = MetricLogger(args.metrics, run_meta=dict(
             mode='serve_multi', replicas=args.replicas,
             buckets=list(buckets), batch_size=args.batch_size,
-            dtype=engines[0].dtype_name))
+            dtype=engines[0].dtype_name,
+            precision_mixes=[e.precision_name for e in engines]))
         telemetry = RouterTelemetry(router, admission, logger)
         telemetry.arm()
 
@@ -399,6 +424,7 @@ def serve_multi(args):
     report = dict(
         ok=ok,
         replicas=args.replicas,
+        precision_mixes=[e.precision_name for e in engines],
         requests=dict(total=len(lengths), answered=len(pending) -
                       len(unanswered), **admission.snapshot()),
         batches=router.batches_dispatched,

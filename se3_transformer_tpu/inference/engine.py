@@ -25,6 +25,14 @@ Params stay a call argument (not baked), so a checkpoint refresh is
 match. The persistent compilation cache (`utils.compilation_cache`)
 makes even the startup compiles warm across process restarts.
 
+Quantized serving (ROADMAP item 3): `precision='int8_mix'` (or any
+quant.rules mix / explicit rule list) quantizes the params INSIDE the
+params setter — restore-time, on host — so the AOT buckets compile
+against the quantized abstract tree and the fp32 degree-0 weights
+never materialize on device. Weight swaps re-quantize at the engine's
+own mix (zero recompiles — shapes/dtypes are unchanged), and every
+bucket's cost record carries the mix + the before/after param bytes.
+
 Sharded serving (ROADMAP item 3): pass `mesh` (+ optionally
 `partition_rules`, a `parallel.rules` rule set name or rule list —
 default 'tp') and the engine becomes mesh-aware end to end: params are
@@ -82,6 +90,7 @@ class InferenceEngine:
                  timer: Optional[PhaseTimer] = None,
                  mesh: Optional[Mesh] = None,
                  partition_rules=None,
+                 precision=None,
                  precompile: bool = True,
                  fault_injector=None):
         self.module = module
@@ -94,6 +103,21 @@ class InferenceEngine:
         # list (parallel.rules); only consulted when a mesh is given
         self.partition_rules = ('tp' if partition_rules is None
                                 else partition_rules)
+        # weight-precision mix (quant.rules): a shipped mix name
+        # ('int8_mix' / 'bf16' / 'fp8_mix') or explicit (regex,
+        # precision) rules. The params SETTER quantizes — restore-time,
+        # on host, BEFORE the device_put — so the fp32 degree-0 weights
+        # never materialize on device (test-pinned); None/'fp32' is the
+        # bit-identical passthrough. Orthogonal to activation_dtype
+        # (weight storage vs activation compute).
+        self.precision = None if precision in (None, 'fp32') \
+            else precision
+        self.precision_name = 'fp32'
+        self.quant_report = None
+        if self.precision is not None:
+            from ..quant import mix_name, resolve_mix
+            resolve_mix(self.precision)   # fail fast on a bad mix name
+            self.precision_name = mix_name(self.precision)
         self.param_specs = None      # filled by the params setter
         self.params = params         # property setter device_puts once
         self.buckets = tuple(sorted(int(b) for b in buckets))
@@ -149,6 +173,22 @@ class InferenceEngine:
         # full tensor is never replicated across the mesh first), and a
         # weight swap re-places into the SAME specs so the AOT
         # executables keep matching without a recompile.
+        #
+        # With a precision mix, quantization happens HERE, on host,
+        # before any device placement: the quantized pytree (int8/fp8
+        # QuantTensors + scales, bf16 casts) is what lands in HBM — the
+        # fp32 tree never does. The same setter is the rolling-swap
+        # re-quantization contract: `swap_weights(raw_fp32_params)`
+        # re-quantizes at THIS engine's mix (each replica may run its
+        # own), shapes/dtypes are unchanged, so the AOT executables
+        # keep matching — zero drops, zero recompiles. A tree that is
+        # already quantized (e.g. handed between engines) passes
+        # through untouched.
+        if self.precision is not None:
+            from ..quant import is_quantized, quantize_params
+            if not is_quantized(value):
+                value, self.quant_report = quantize_params(
+                    value, self.precision)
         if self.mesh is None:
             self._params = jax.device_put(value)
             return
@@ -162,7 +202,14 @@ class InferenceEngine:
                 if self.activation_dtype is not None else 'float32')
 
     def _key(self, bucket: int) -> Tuple[int, int, str]:
-        return (int(bucket), self.batch_size, self.dtype_name)
+        # the precision mix folds into the key's dtype slot: an int8
+        # engine's executables must never collide with an fp32 one's
+        # in caches keyed on these tuples (the bucket stays slot 0 —
+        # telemetry reads key[0])
+        dt = self.dtype_name
+        if self.precision is not None:
+            dt = f'{dt}+{self.precision_name}'
+        return (int(bucket), self.batch_size, dt)
 
     @property
     def executables(self) -> Dict[Tuple[int, int, str], Callable]:
@@ -241,10 +288,19 @@ class InferenceEngine:
             # split + flops, the capacity-planning surface (guarded —
             # introspection must never fail a compile that succeeded)
             from ..observability.costs import cost_payload
-            self.cost_payloads[key] = cost_payload(
+            body = cost_payload(
                 executable,
                 label=f'bucket_{bucket},b={self.batch_size},'
-                      f'dtype={self.dtype_name}')
+                      f'dtype={self.dtype_name},'
+                      f'precision={self.precision_name}')
+            # the precision mix + the restore-time before/after param
+            # bytes ride every bucket's cost record — the per-replica
+            # memory claim is a ledger field, not prose (extra fields
+            # are schema-legal on cost records)
+            body['precision_mix'] = self.precision_name
+            if self.quant_report is not None:
+                body['quant'] = dict(self.quant_report)
+            self.cost_payloads[key] = body
         except Exception as e:  # noqa: BLE001
             import sys
             print(f'engine: cost introspection failed for bucket '
@@ -357,6 +413,9 @@ class InferenceEngine:
         return dict(
             buckets=list(self.buckets), batch_size=self.batch_size,
             dtype=self.dtype_name, sharding=sharding,
+            precision=self.precision_name,
+            quant=(dict(self.quant_report)
+                   if self.quant_report is not None else None),
             executables=[list(k) for k in self._executables],
             compile_seconds={str(k[0]): v
                              for k, v in self.compile_seconds.items()},

@@ -259,7 +259,7 @@ def _banded_z_c(xr, d_in: int, d_out: int, a, b):
 
 
 def _kv_block(arm: str, pairs, d_out: int, xg, h, sh, fr, w3, b3,
-              consts):
+              consts, w3_scale=None):
     """One slot block's keyed features, entirely in registers/VMEM:
     xg tuple of [..., C, Q] gathered features (one per input degree),
     h [..., mid] radial hidden, sh [..., S] SH stack (dense arm),
@@ -267,7 +267,13 @@ def _kv_block(arm: str, pairs, d_out: int, xg, h, sh, fr, w3, b3,
     radial params, consts from _arm_consts -> [..., O, P]. Matches
     ConvSE3's grouped shared-radial contraction segment-for-segment
     (same params, same concat order), so the fused path is
-    checkpoint-compatible."""
+    checkpoint-compatible.
+
+    `w3_scale` [1, IF, O] is the quantized-serving epilogue: `w3` is
+    then int8/fp8 storage riding as a kernel input ref, upcast in-tile
+    for the radial dot, the per-channel scale folded into R — the fp32
+    grouped weight never exists in HBM (quant.rules / the
+    _radial_contract epilogue, kernel-side)."""
     segs = []
     for i, ((d_in, _), x) in enumerate(zip(pairs, xg)):
         if arm == 'dense':
@@ -289,8 +295,14 @@ def _kv_block(arm: str, pairs, d_out: int, xg, h, sh, fr, w3, b3,
             raise ValueError(f'unknown contraction arm {arm!r} '
                              f'(known: {ARMS})')
     z = jnp.concatenate(segs, axis=-1) if len(segs) > 1 else segs[0]
-    R = jnp.einsum('...m,mio->...io', h, w3,
-                   preferred_element_type=jnp.float32) + b3
+    if w3_scale is not None:
+        R = jnp.einsum('...m,mio->...io', h,
+                       w3.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) \
+            * w3_scale[0] + b3
+    else:
+        R = jnp.einsum('...m,mio->...io', h, w3,
+                       preferred_element_type=jnp.float32) + b3
     out = jnp.einsum('...pi,...io->...po', z, R)
     out = jnp.swapaxes(out, -1, -2)                     # [..., O, P]
     if arm == 'so2':
@@ -584,13 +596,15 @@ def _chunk_body(cfg: FlashConfig, chunk, full):
 
     consts = full['consts']
     kv_v = _kv_block(cfg.arm_v, cfg.pairs, cfg.d_out, xg, h_v, sh, fr,
-                     full['wv'], full['bv'], consts)
+                     full['wv'], full['bv'], consts,
+                     w3_scale=full.get('wv_scale'))
     kv_v = kv_v.reshape(*kv_v.shape[:-2], kv_h, Dh)
     if cfg.tie:
         kv_k = kv_v
     else:
         kv_k = _kv_block(cfg.arm_k, cfg.pairs, cfg.d_out, xg, h_k, sh,
-                         fr, full['wk'], full['bk'], consts)
+                         fr, full['wk'], full['bk'], consts,
+                         w3_scale=full.get('wk_scale'))
         kv_k = kv_k.reshape(*kv_k.shape[:-2], kv_h, Dh)
 
     if cfg.prefix:
@@ -740,13 +754,17 @@ def _flash_kernel_body(cfg: FlashConfig, spec, dims, *refs):
 
     consts = {k[2:]: named[k][...] for k in spec if k.startswith('c_')}
     kv_v = _kv_block(cfg.arm_v, cfg.pairs, cfg.d_out, xg, h_v, sh, fr,
-                     named['wv'][...], named['bv'][...], consts)
+                     named['wv'][...], named['bv'][...], consts,
+                     w3_scale=(named['wv_scale'][...]
+                               if 'wv_scale' in named else None))
     kv_v = kv_v.reshape(bn, bj, kv_h, Dh)
     if cfg.tie:
         kv_k = kv_v
     else:
         kv_k = _kv_block(cfg.arm_k, cfg.pairs, cfg.d_out, xg, h_k, sh,
-                         fr, named['wk'][...], named['bk'][...], consts)
+                         fr, named['wk'][...], named['bk'][...], consts,
+                         w3_scale=(named['wk_scale'][...]
+                                   if 'wk_scale' in named else None))
         kv_k = kv_k.reshape(bn, bj, kv_h, Dh)
 
     # slots past the true axis length exist only because of the block
@@ -877,9 +895,18 @@ def _flash_fwd_impl(cfg: FlashConfig, ops: dict) -> jnp.ndarray:
 
     add('wv', ops['wv'], ops['wv'].shape, lambda b, i, j: (0, 0, 0))
     add('bv', ops['bv'], ops['bv'].shape, lambda b, i, j: (0, 0))
+    if 'wv_scale' in ops:
+        # quantized grouped radial weights: the per-channel dequant
+        # scales ride as their own [1, IF, O] input ref, like PR 11's
+        # contraction constants
+        add('wv_scale', ops['wv_scale'], ops['wv_scale'].shape,
+            lambda b, i, j: (0, 0, 0))
     if not cfg.tie:
         add('wk', ops['wk'], ops['wk'].shape, lambda b, i, j: (0, 0, 0))
         add('bk', ops['bk'], ops['bk'].shape, lambda b, i, j: (0, 0))
+        if 'wk_scale' in ops:
+            add('wk_scale', ops['wk_scale'], ops['wk_scale'].shape,
+                lambda b, i, j: (0, 0, 0))
     if cfg.prefix:
         S0 = cfg.prefix
         KD = kv_h * Dh
@@ -981,7 +1008,8 @@ def flash_attention(q, xs, idx, nmask, h_v, wv, bv, *,
                     pairs, d_out, heads, kv_heads, scale,
                     arm_v='dense', arm_k=None, h_k=None, wk=None,
                     bk=None, sh=None, frames=None, prefix_k=None,
-                    prefix_v=None, pallas=None, interpret=False
+                    prefix_v=None, wv_scale=None, wk_scale=None,
+                    pallas=None, interpret=False
                     ) -> jnp.ndarray:
     """Streaming kNN equivariant attention for ONE output degree.
 
@@ -1006,10 +1034,17 @@ def flash_attention(q, xs, idx, nmask, h_v, wv, bv, *,
         use_pallas=_resolve_pallas(pallas, interpret),
         interpret=interpret)
     ops = dict(q=q, xs=tuple(xs), idx=idx, h_v=h_v, wv=wv, bv=bv)
+    if wv_scale is not None:
+        # quantized grouped radial weights (quant.QuantTensor split by
+        # the caller): wv is int8/fp8 storage, the scale dequants
+        # in-tile as an epilogue on the radial dot
+        ops['wv_scale'] = jnp.asarray(wv_scale, jnp.float32)
     if nmask is not None:
         ops['nmask'] = nmask
     if not tie:
         ops.update(wk=wk, bk=bk)
+        if wk_scale is not None:
+            ops['wk_scale'] = jnp.asarray(wk_scale, jnp.float32)
         if h_k is not None:
             ops['h_k'] = h_k
     if 'dense' in (arm_v, arm_k if not tie else arm_v):

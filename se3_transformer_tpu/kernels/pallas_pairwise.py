@@ -251,17 +251,34 @@ def _pick_blocks(E: int, IF: int, O: int, P: int, mid: int,
     return _consult_table('plain', (E, IF, O, P, mid), dtype, _heuristic)
 
 
-def _fwd_kernel(ht_ref, w3t_ref, b3t_ref, v2t_ref, o_ref, *, P, O, bif,
-                precision):
+def _fwd_kernel(ht_ref, w3t_ref, b3t_ref, *rest, P, O, bif,
+                precision, scaled=False):
+    if scaled:
+        st_ref, v2t_ref, o_ref = rest
+    else:
+        st_ref, (v2t_ref, o_ref) = None, rest
     f = pl.program_id(1)
+    w = w3t_ref[:]
+    hb = ht_ref[:]
+    if w.dtype != hb.dtype:
+        # quantized storage (int8/fp8 serving mixes): dequant INSIDE
+        # the tile — upcast the VMEM block for the dot, then fold the
+        # per-(if,o)-channel scale column in below. The fp32 weight
+        # never exists outside this tile.
+        w = w.astype(hb.dtype if hb.dtype == jnp.bfloat16
+                     else jnp.float32)
     # R chunk, transposed: [bif*O, E_b] — exists only in VMEM. The bias
     # column broadcasts over lanes ([S, 1] + [S, E], the row-stat pattern
-    # flash-attention kernels lower every day).
+    # flash-attention kernels lower every day); the quant scale column
+    # rides the same way ([S, 1] * [S, E]).
     rt = jax.lax.dot_general(
-        w3t_ref[:], ht_ref[:],
+        w, hb,
         dimension_numbers=(((1,), (0,)), ((), ())),
         precision=precision,
-        preferred_element_type=jnp.float32) + b3t_ref[:]
+        preferred_element_type=jnp.float32)
+    if scaled:
+        rt = rt * st_ref[:]
+    rt = rt + b3t_ref[:]
     for p in range(P):
         acc = None
         for i in range(bif):
@@ -304,7 +321,8 @@ def _bias_column(b3, IF, O, IFp):
     return b3t
 
 
-def _fused_pairwise_conv_impl(h, w3, b3, v2, interpret, precision):
+def _fused_pairwise_conv_impl(h, w3, b3, v2, interpret, precision,
+                              w3_scale=None):
     E, mid = h.shape
     _, IF, O = w3.shape
     P = v2.shape[1]
@@ -321,7 +339,11 @@ def _fused_pairwise_conv_impl(h, w3, b3, v2, interpret, precision):
         precision = jax.lax.Precision.DEFAULT
         if interpret:  # CPU interpret can't dispatch BF16xBF16=F32 dots;
             # the upcast is exact and accumulation is f32 either way
-            h, w3 = h.astype(jnp.float32), w3.astype(jnp.float32)
+            h = h.astype(jnp.float32)
+            if w3_scale is None:
+                w3 = w3.astype(jnp.float32)
+            # quantized w3 keeps its storage dtype — the kernel body's
+            # dtype-mismatch upcast is the dequant-in-tile
     if v2.dtype == jnp.bfloat16 and interpret:
         # conv_bf16 under interpret: the kernel body upcasts bf16 rows to
         # f32 right after the (Mosaic-only) VMEM load, so pre-upcasting
@@ -342,25 +364,40 @@ def _fused_pairwise_conv_impl(h, w3, b3, v2, interpret, precision):
 
     n_e, n_if = Ep // block_e, IFp // block_if
 
+    scaled = w3_scale is not None
+    in_specs = [
+        pl.BlockSpec((mid, block_e), lambda e, f: (0, e),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_if * O, mid), lambda e, f: (f, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_if * O, 1), lambda e, f: (f, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [ht, w3t, b3t]
+    if scaled:
+        # per-(if,o)-channel dequant scales in the w3T row order — the
+        # same [S, 1] column layout (and zero-row padding) as the bias
+        st = _bias_column(jnp.asarray(w3_scale, jnp.float32).reshape(
+            IF, O), IF, O, IFp)
+        in_specs.append(pl.BlockSpec((block_if * O, 1),
+                                     lambda e, f: (f, 0),
+                                     memory_space=pltpu.VMEM))
+        args.append(st)
+    in_specs.append(pl.BlockSpec((P, block_if, block_e),
+                                 lambda e, f: (0, f, e),
+                                 memory_space=pltpu.VMEM))
+    args.append(v2t)
+
     outt = pl.pallas_call(
         functools.partial(_fwd_kernel, P=P, O=O, bif=block_if,
-                          precision=precision),
+                          precision=precision, scaled=scaled),
         grid=(n_e, n_if),
-        in_specs=[
-            pl.BlockSpec((mid, block_e), lambda e, f: (0, e),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_if * O, mid), lambda e, f: (f, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_if * O, 1), lambda e, f: (f, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, block_if, block_e), lambda e, f: (0, f, e),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((P * O, block_e), lambda e, f: (0, e),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((P * O, Ep), jnp.float32),
         interpret=interpret,
-    )(ht, w3t, b3t, v2t)
+    )(*args)
 
     return outt.reshape(P, O, Ep).transpose(2, 0, 1)[:E]
 
@@ -497,7 +534,8 @@ def _fwd_partitioned(interpret, precision):
 def fused_pairwise_conv(h: jnp.ndarray, w3: jnp.ndarray, v2: jnp.ndarray,
                         b3: jnp.ndarray = None,
                         interpret: bool = False,
-                        precision=None) -> jnp.ndarray:
+                        precision=None,
+                        w3_scale: jnp.ndarray = None) -> jnp.ndarray:
     """h [E, mid], w3 [mid, IF, O], v2 [E, P, IF], b3 [IF, O] (optional,
     zeros when None) -> out [E, P, O] (f32): out = v2 . (h@w3 + b3).
 
@@ -507,9 +545,20 @@ def fused_pairwise_conv(h: jnp.ndarray, w3: jnp.ndarray, v2: jnp.ndarray,
     dots (captured from jax.default_matmul_precision by the caller — the
     kernel body traces outside that context). Partitions over sharded
     edge/output-channel axes (see the SPMD rules above).
+
+    `w3_scale` [1, IF, O] switches on the quantized-serving epilogue:
+    `w3` is then int8/fp8 STORAGE, dequantized inside the tile (upcast
+    of the VMEM block + a per-(if,o)-channel scale column riding like
+    the bias operand) so the fp32 radial weight never exists in HBM —
+    out = v2 . ((h@w3) * scale + b3). Single-program only: the SPMD
+    partition rules describe the 4-operand fp path, and quantized
+    serving replicates params (quant + tp sharding is follow-up work).
     """
     if b3 is None:
         b3 = jnp.zeros(w3.shape[1:], jnp.float32)
+    if w3_scale is not None:
+        return _fused_pairwise_conv_impl(h, w3, b3, v2, interpret,
+                                         precision, w3_scale=w3_scale)
     return _fwd_partitioned(interpret, precision)(h, w3, b3, v2)
 
 

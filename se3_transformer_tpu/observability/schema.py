@@ -94,6 +94,24 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    chaos-smoke` and obs_report --require fault gate
                    on it, and a fault record with zero injections
                    proves nothing).
+  quant_ab         fp32-vs-quantized-mix serving A/B
+                   (bench.quant_main via scripts/quant_smoke.py): mix
+                   (the quant.rules precision mix), buckets (per-bucket
+                   {fp32_ms, quant_ms, quant_vs_fp32} — the
+                   latency-vs-error tradeoff banked per bucket), and
+                   the load-bearing quartet: argument_bytes_ratio
+                   (quantized/fp32 argument bytes off the PR 6 cost
+                   ledger — the per-replica memory claim),
+                   parity_max_abs (quant engine vs the fp32 REFERENCE
+                   EVALUATION of the same quantized weights — the
+                   serving path must add nothing beyond quantization
+                   itself; gated at 1e-4), quant_error_max_abs (vs the
+                   raw fp32 engine — the accuracy tradeoff, banked not
+                   hidden), equivariance_l2 (worst over the swept
+                   degrees; weight-only quantization must preserve
+                   equivariance). `make quant-smoke` gates it and
+                   PERF_BUDGETS.json enforces ratio + parity +
+                   equivariance.
   so2_sweep        per-degree so2-vs-dense contraction A/B
                    (bench.degrees_main via scripts/so2_smoke.py):
                    label, degrees (per-max-degree {so2_step_ms,
@@ -119,7 +137,7 @@ SCHEMA_VERSION = 1
 
 KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
                'serve', 'tune', 'comm', 'cost', 'profile', 'so2_sweep',
-               'flash', 'fault', 'summary')
+               'flash', 'fault', 'quant_ab', 'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
@@ -160,6 +178,13 @@ _REQUIRED = {
     'fault': ('run_id', 'label', 'injections', 'injections_total',
               'health_transitions', 'recoveries', 'retries',
               'request_failures', 'timeouts', 'lost_requests'),
+    # the memory ratio + the parity/equivariance figures are the
+    # load-bearing quartet of the quantized-serving contract: a record
+    # that cannot say the mix is smaller, implementation-faithful, AND
+    # still equivariant — with its accuracy cost banked — proves nothing
+    'quant_ab': ('run_id', 'label', 'mix', 'buckets',
+                 'argument_bytes_ratio', 'parity_max_abs',
+                 'quant_error_max_abs', 'equivariance_l2'),
     # equivariance_l2_so2 per degree is the load-bearing field of the
     # backend contract: a sweep record that cannot say the reduced
     # contraction is still equivariant proves nothing about the speedup
@@ -414,6 +439,28 @@ def validate_record(rec: dict, index=None) -> dict:
             if not isinstance(val, (int, float)) or isinstance(val, bool) \
                     or val < 0:
                 _fail(index, f'flash.{field} must be a non-negative '
+                             f'number, got {val!r}')
+    if kind == 'quant_ab':
+        if not isinstance(rec['mix'], str) or not rec['mix']:
+            _fail(index, f'quant_ab.mix must be a non-empty string, '
+                         f'got {rec["mix"]!r}')
+        buckets = rec['buckets']
+        if not isinstance(buckets, dict) or not buckets:
+            _fail(index, 'quant_ab.buckets must be a non-empty object '
+                         '(bucket -> per-arm latency entry)')
+        for bucket, entry in buckets.items():
+            missing = [k for k in ('fp32_ms', 'quant_ms', 'quant_vs_fp32')
+                       if not isinstance(entry, dict) or k not in entry]
+            if missing:
+                _fail(index, f'quant_ab.buckets[{bucket!r}] missing '
+                             f'{missing} (the per-bucket latency A/B IS '
+                             f'the tradeoff record)')
+        for field in ('argument_bytes_ratio', 'parity_max_abs',
+                      'quant_error_max_abs', 'equivariance_l2'):
+            val = rec[field]
+            if not isinstance(val, (int, float)) or isinstance(val, bool) \
+                    or val < 0:
+                _fail(index, f'quant_ab.{field} must be a non-negative '
                              f'number, got {val!r}')
     if kind == 'so2_sweep':
         degrees = rec['degrees']

@@ -355,17 +355,24 @@ class AttentionSE3(nn.Module):
 
             xs = tuple(features[str(d_in)]
                        for d_in, _ in v_prog['pairs'])
+            # quantized serving (quant.QuantTensor grouped weights):
+            # split storage/scale so the int8 weight rides into the
+            # kernel as-is and the scale dequants in-tile
+            from ..quant.qtensor import weight_or_none
+            wv, wv_scale = weight_or_none(v_prog['w3'][degree])
             kwargs = dict(sh=sh, frames=frames,
                           prefix_k=prefix_k, prefix_v=prefix_v,
+                          wv_scale=wv_scale,
                           pallas=self.pallas,
                           interpret=self.flash_interpret)
             if k_prog is not None:
-                kwargs.update(h_k=k_prog['h'], wk=k_prog['w3'][degree],
+                wk, wk_scale = weight_or_none(k_prog['w3'][degree])
+                kwargs.update(h_k=k_prog['h'], wk=wk, wk_scale=wk_scale,
                               bk=k_prog['b3'][degree],
                               arm_k=k_prog['arm'])
             out = flash_attention(
                 q, xs, neighbor_indices, neighbor_mask, v_prog['h'],
-                v_prog['w3'][degree], v_prog['b3'][degree],
+                wv, v_prog['b3'][degree],
                 pairs=v_prog['pairs'], d_out=int(degree), heads=h,
                 kv_heads=kv_h, scale=self.dim_head ** -0.5,
                 arm_v=v_prog['arm'], **kwargs)

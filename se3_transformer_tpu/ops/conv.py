@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.exchange import exchange_index_select
+from ..quant.qtensor import QuantTensor, concat_weights
 from ..utils.helpers import fourier_encode, masked_mean, to_order
 from .core import LinearSE3, residual_se3
 from .fiber import Fiber
@@ -117,9 +118,50 @@ class RadialFunc(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         x = radial_hidden(x, self.mid_dim)
-        x = nn.Dense(self.num_freq * self.in_dim * self.out_dim)(x)
+        # explicit name: radial_hidden's trunk layers are explicitly
+        # named Dense_0/Dense_1 (quant-aware clones), so the auto
+        # counter would restart and collide without it — Dense_2 is the
+        # path this layer has always had
+        x = nn.Dense(self.num_freq * self.in_dim * self.out_dim,
+                     name='Dense_2')(x)
         return x.reshape(*x.shape[:-1], self.out_dim, self.in_dim,
                          self.num_freq)
+
+
+class _QuantDense(nn.Module):
+    """nn.Dense with a quant-aware kernel, parameter-compatible with the
+    flax original (same param names/shapes/initializers and the same
+    params-rng derivation, so checkpoints and seeded inits are
+    bit-identical) — needed because the radial trunk's kernels are
+    int8 targets under the serving precision mixes (quant.rules) and
+    nn.Dense cannot consume a QuantTensor. The fp32/bf16 paths replay
+    nn.Dense's exact promote_dtype + dot_general sequence."""
+    features: int
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        kernel = self.param('kernel', nn.initializers.lecun_normal(),
+                            (jnp.shape(x)[-1], self.features),
+                            jnp.float32)
+        bias = self.param('bias', nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        if isinstance(kernel, QuantTensor):
+            # fused dequant-matmul: the int8 kernel contracts, the
+            # per-output-channel scale folds into the product — the
+            # fp32 kernel never exists outside this fusion. Invariant
+            # inputs, so this is the int8-safe class (quant.rules).
+            y = jax.lax.dot_general(
+                x, jnp.asarray(kernel.q).astype(x.dtype),
+                (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return y * kernel.scale[0] + bias
+        from flax.linen.dtypes import promote_dtype
+        x, kernel, bias = promote_dtype(x, kernel, bias,
+                                        dtype=self.dtype)
+        y = jax.lax.dot_general(x, kernel,
+                                (((x.ndim - 1,), (0,)), ((), ())))
+        return y + jnp.reshape(bias, (1,) * (y.ndim - 1) + (-1,))
 
 
 def radial_hidden(x: jnp.ndarray, mid_dim: int,
@@ -132,12 +174,16 @@ def radial_hidden(x: jnp.ndarray, mid_dim: int,
     rotated and an unrotated forward and cancels in the equivariance
     error — this is the principled TPU mixed-precision cut, unlike a
     global bf16 matmul policy which quantizes the equivariant
-    contractions themselves (~1e-3 equivariance error on chip)."""
-    x = nn.Dense(mid_dim, dtype=dtype)(x)
-    x = nn.LayerNorm(dtype=dtype)(x)
+    contractions themselves (~1e-3 equivariance error on chip). The
+    same invariance argument admits int8 kernels under the serving
+    precision mixes (quant.rules), which is why the Dense layers are
+    the quant-aware clone (explicit names keep the nn.Dense param
+    paths, so checkpoints predate the swap unchanged)."""
+    x = _QuantDense(mid_dim, dtype=dtype, name='Dense_0')(x)
+    x = nn.LayerNorm(dtype=dtype, name='LayerNorm_0')(x)
     x = nn.gelu(x)
-    x = nn.Dense(mid_dim, dtype=dtype)(x)
-    x = nn.LayerNorm(dtype=dtype)(x)
+    x = _QuantDense(mid_dim, dtype=dtype, name='Dense_1')(x)
+    x = nn.LayerNorm(dtype=dtype, name='LayerNorm_1')(x)
     x = nn.gelu(x)
     return x
 
@@ -481,26 +527,59 @@ def _radial_contract(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
         # matmul-precision policy at trace time: the custom_vjp backward
         # traces outside the model's default_matmul_precision context,
         # so it must be threaded in.
-        w3c = w3.astype(h.dtype)
         prec = jax.config.jax_default_matmul_precision
+        if isinstance(w3, QuantTensor):
+            # quantized radial weights (serving precision mixes): the
+            # int8/fp8 STORAGE rides into the kernel as-is and dequant
+            # happens inside the tile via the scale-column epilogue —
+            # the fp32 w3 never exists in HBM. No custom_vjp: the
+            # quantized tree is an inference artifact, gradients
+            # through it are a configuration error and fail loudly.
+            from ..kernels.pallas_pairwise import fused_pairwise_conv
+            w3_q, w3_scale = w3.q, w3.scale
 
-        def contract(h_c, v2_c):
-            lead_c = h_c.shape[:-1]
-            E = 1
-            for s in lead_c:
-                E *= s
-            h2 = h_c.reshape(E, h_c.shape[-1])
-            out = _pairwise_contract_pallas(h2, w3c, b3,
-                                            v2_c.reshape(E, P, IF),
-                                            pallas_interpret, prec)
-            return out.reshape(*lead_c, P, O)
+            def contract(h_c, v2_c):
+                lead_c = h_c.shape[:-1]
+                E = 1
+                for s in lead_c:
+                    E *= s
+                out = fused_pairwise_conv(
+                    h_c.reshape(E, h_c.shape[-1]), w3_q,
+                    v2_c.reshape(E, P, IF), b3=b3, w3_scale=w3_scale,
+                    interpret=pallas_interpret, precision=prec)
+                return out.reshape(*lead_c, P, O)
+        else:
+            w3c = w3.astype(h.dtype)
+
+            def contract(h_c, v2_c):
+                lead_c = h_c.shape[:-1]
+                E = 1
+                for s in lead_c:
+                    E *= s
+                h2 = h_c.reshape(E, h_c.shape[-1])
+                out = _pairwise_contract_pallas(h2, w3c, b3,
+                                                v2_c.reshape(E, P, IF),
+                                                pallas_interpret, prec)
+                return out.reshape(*lead_c, P, O)
     else:
         def contract(h_c, v2_c):
             # bias stays f32 (the Pallas path adds it to the f32
             # accumulator), so both dispatch paths compute identical
             # values even under radial_bf16
-            R = jnp.einsum('...m,mko->...ko', h_c, w3.astype(h_c.dtype),
-                           preferred_element_type=jnp.float32) + b3
+            if isinstance(w3, QuantTensor):
+                # jit-level fused dequant-matmul (the XLA fallback the
+                # ISSUE names): contract the storage form, fold the
+                # per-output-channel scale in as the epilogue — the
+                # fp32 weight exists at most as a fused temp inside
+                # the dot, never as an argument buffer
+                R = jnp.einsum('...m,mko->...ko', h_c,
+                               jnp.asarray(w3.q).astype(h_c.dtype),
+                               preferred_element_type=jnp.float32) \
+                    * w3.scale[0] + b3
+            else:
+                R = jnp.einsum('...m,mko->...ko', h_c,
+                               w3.astype(h_c.dtype),
+                               preferred_element_type=jnp.float32) + b3
             return jnp.einsum('...pk,...ko->...po', v2_c, R)
 
     if edge_chunks is None:
@@ -524,6 +603,14 @@ def _radial_contract_bx(h: jnp.ndarray, w3: jnp.ndarray, b3: jnp.ndarray,
     conv_bf16 stores the basis and gathered-feature operands bf16 (half
     the kernel's biggest HBM streams; math stays f32 on the quantized
     values — see _radial_contract's tradeoff note)."""
+    if isinstance(w3, QuantTensor):
+        # fuse_basis + quantized weights: dequantize as a TRANSIENT
+        # inside the traced program (a weight-sized temp, tiny next to
+        # the edge tensors this path streams) so the bx/bxf custom_vjp
+        # plumbing stays untouched; the param-tree argument is still
+        # the int8 storage. The plain-kernel path above gets the true
+        # in-tile epilogue.
+        w3 = w3.dequant()
     flat = _basis_is_flat(basis, x)
     if flat:
         assert pqf is not None, 'flat basis needs explicit (P, Q, F)'
@@ -668,7 +755,9 @@ class ConvSE3(nn.Module):
                         m_out)
                     ws.append(w3)
                     bs.append(b3)
-                w3s[str(degree_out)] = jnp.concatenate(ws, axis=1)
+                # quant-aware: grouped QuantTensors concatenate q and
+                # scale along the same (non-contracted) IF axis
+                w3s[str(degree_out)] = concat_weights(ws, axis=1)
                 b3s[str(degree_out)] = jnp.concatenate(bs, axis=0)
             return dict(h=hidden,
                         pairs=tuple((d, c) for d, c in self.fiber_in),
@@ -729,7 +818,7 @@ class ConvSE3(nn.Module):
                     z_segs.append(banded_z(rotated[str(degree_in)],
                                            degree_in, degree_out))
                 acc = _radial_contract(
-                    hidden, jnp.concatenate(w3s, axis=1),
+                    hidden, concat_weights(w3s, axis=1),
                     jnp.concatenate(b3s, axis=0),
                     jnp.concatenate(z_segs, axis=-1),
                     pallas=self.pallas,
@@ -794,7 +883,7 @@ class ConvSE3(nn.Module):
                     b3s.append(b3)
                 if not fuse_bx:
                     acc = _radial_contract(
-                        hidden, jnp.concatenate(w3s, axis=1),
+                        hidden, concat_weights(w3s, axis=1),
                         jnp.concatenate(b3s, axis=0),
                         jnp.concatenate(v2s, axis=-1),
                         pallas=self.pallas,

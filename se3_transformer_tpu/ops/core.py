@@ -16,11 +16,28 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..quant.qtensor import QuantTensor
 from ..utils.helpers import safe_norm
 from .fiber import Fiber
 
 
 Features = Dict[str, jnp.ndarray]
+
+
+def channel_mix(x: jnp.ndarray, w) -> jnp.ndarray:
+    """The per-degree channel contraction `x [..., c, m] @ w [c, e] ->
+    [..., e, m]`, quant-aware: a QuantTensor weight contracts in its
+    int8/fp8 STORAGE form and the per-output-channel scale folds in as
+    an epilogue — the fp32 weight never exists outside this fusion
+    (serving's restore-time quantization rides on exactly that). A bf16
+    weight promotes through the einsum; math stays f32 either way."""
+    if isinstance(w, QuantTensor):
+        out = jnp.einsum('...cm,ce->...em', x,
+                         jnp.asarray(w.q).astype(x.dtype),
+                         preferred_element_type=jnp.float32)
+        # scale [1, e] -> [e, 1]: the output channel axis is -2
+        return out * w.scale[0][:, None]
+    return jnp.einsum('...cm,ce->...em', x, w)
 
 
 def residual_se3(x: Features, res: Features) -> Features:
@@ -49,7 +66,7 @@ class LinearSE3(nn.Module):
                 f'w{key}',
                 nn.initializers.normal(stddev=dim_in ** -0.5),
                 (dim_in, dim_out), x[key].dtype)
-            out[key] = jnp.einsum('...cm,ce->...em', x[key], w)
+            out[key] = channel_mix(x[key], w)
         return out
 
 

@@ -81,6 +81,8 @@ def make_sharded_train_step(loss_fn: Callable, optimizer,
                             donate: bool = True,
                             donate_batch: bool = False,
                             tensor_parallel: bool = False,
+                            sharded_state: bool = False,
+                            state_shardings=None,
                             telemetry: bool = False):
     """loss_fn(params, batch, rng) -> (loss, aux). Returns
     step(params, opt_state, batch, rng) -> (params, opt_state, loss, aux),
@@ -90,6 +92,28 @@ def make_sharded_train_step(loss_fn: Callable, optimizer,
     the caller gave them (see `shard_params`), so tp-partitioned weights
     stay partitioned through the update and GSPMD inserts the psum for
     the row-parallel contractions.
+
+    `sharded_state=True` is the true-FSDP wiring (ROADMAP item 4's
+    named next step): like tensor_parallel, params AND optimizer state
+    follow the placement the caller gave them — the caller shards
+    params with `shard_params(..., rules='fsdp')` and the optimizer
+    state with `parallel.rules.shard_opt_state` (adam's mu/nu inherit
+    their param's audited spec), and the step's in/out shardings stay
+    None on both so the update runs shard-local and nothing
+    re-replicates. Before this flag, opt state replicated by default on
+    every chip — 2x the parameter memory — despite the specs existing.
+
+    `state_shardings=(param_shardings, opt_shardings)` (pytrees of
+    NamedSharding matching the state trees) PINS the step's in AND out
+    shardings for params/opt_state to exactly those placements. This is
+    the explicit-aliasing route around the jax-0.4.37 GSPMD donation
+    bug (the PR 5 residue): with out_shardings left to AUTO, GSPMD may
+    pick a FINER output sharding than the donated input carries (e.g.
+    dp+sp on a multi-axis mesh where the input is dp-only) and the
+    donation dies in an INTERNAL aliased-size error — pinning output
+    to input keeps every alias shape-preserving. The caller knows the
+    placements (it made them with shard_params/shard_opt_state), so it
+    passes them; DenoiseTrainer does this under cfg.fsdp.
 
     With `telemetry=True` the step signature grows by exactly one
     argument/result — an `observability.MetricAccumulator` pytree that
@@ -102,7 +126,11 @@ def make_sharded_train_step(loss_fn: Callable, optimizer,
     telemetry accumulator) — always safe: the caller rebinds all three
     to the step's outputs, and sharded buffers are donated in place so
     tp-partitioned training resumes/continues without a host round
-    trip; checkpointing snapshots device copies first
+    trip; with `sharded_state` the donated adam mu/nu are themselves
+    sharded and alias their (identically-sharded) outputs shard-for-
+    shard — the input and output live on the same devices with the
+    same per-shard shapes, so donation stays an in-place alias, never
+    a cross-device move; checkpointing snapshots device copies first
     (`training.checkpoint.snapshot_device_arrays`), so async saves
     survive the donation too. `donate_batch=True` additionally donates
     the batch pytree (argnum 2) and is OPT-IN: it is only safe when
@@ -141,9 +169,15 @@ def make_sharded_train_step(loss_fn: Callable, optimizer,
     repl = replicated(mesh)
     acc_in = (repl,) if telemetry else ()
     acc_out = (repl,) if telemetry else ()
-    if tensor_parallel:
+    if state_shardings is not None:
+        ps, os_ = state_shardings
+        return jax.jit(fn, in_shardings=(ps, os_, None, repl) + acc_in,
+                       out_shardings=(ps, os_, repl, repl) + acc_out,
+                       donate_argnums=donate_argnums)
+    if tensor_parallel or sharded_state:
         # None = follow the argument/result placement (params arrive
-        # pre-sharded by shard_params; donation keeps buffers in place)
+        # pre-sharded by shard_params, opt state — under sharded_state —
+        # by shard_opt_state; donation keeps buffers in place)
         return jax.jit(fn, in_shardings=(None, None, None, repl) + acc_in,
                        out_shardings=(None, None, repl, repl) + acc_out,
                        donate_argnums=donate_argnums)
@@ -159,6 +193,8 @@ def make_accumulating_train_step(loss_fn: Callable, optimizer,
                                  mesh: Optional[Mesh] = None,
                                  donate_batch: bool = False,
                                  tensor_parallel: bool = False,
+                                 sharded_state: bool = False,
+                                 state_shardings=None,
                                  telemetry: bool = False):
     """Gradient-accumulation variant (reference denoise.py:13,55 uses 16
     micro-steps). batch leaves must have a leading [accum_steps, ...] axis;
@@ -170,7 +206,10 @@ def make_accumulating_train_step(loss_fn: Callable, optimizer,
     the flushed window's loss min/max expose a diverging micro-batch.
     `donate_batch=True` donates the stacked micro-batch pytree — same
     opt-in safety contract as make_sharded_train_step (fresh batch per
-    step only)."""
+    step only). `sharded_state=True` follows the caller's params AND
+    opt-state placement (the true-FSDP wiring — see
+    make_sharded_train_step's donation audit: sharded mu/nu donate as
+    in-place aliases)."""
 
     def _grads_and_losses(params, batch, rng):
         def micro(carry, xs):
@@ -212,7 +251,14 @@ def make_accumulating_train_step(loss_fn: Callable, optimizer,
         return jax.jit(fn, donate_argnums=donate_argnums)
     repl = replicated(mesh)
     acc_s = (repl,) if telemetry else ()
-    if tensor_parallel:
+    if state_shardings is not None:
+        # pinned state placements (see make_sharded_train_step: the
+        # explicit-aliasing route around the GSPMD donation bug)
+        ps, os_ = state_shardings
+        return jax.jit(fn, in_shardings=(ps, os_, None, repl) + acc_s,
+                       out_shardings=(ps, os_, repl, repl) + acc_s,
+                       donate_argnums=donate_argnums)
+    if tensor_parallel or sharded_state:
         return jax.jit(fn, in_shardings=(None, None, None, repl) + acc_s,
                        out_shardings=(None, None, repl, repl) + acc_s,
                        donate_argnums=donate_argnums)

@@ -1,0 +1,189 @@
+"""QuantTensor: an int8/fp8 weight + its per-output-channel scales, as
+one pytree node that rides wherever the fp32 weight used to.
+
+The serving memory problem is ARGUMENT bytes: a restored fp32 param
+tree is the largest per-replica HBM resident, and PR 6's cost ledger
+splits it out per bucket (`memory.argument_bytes`). Post-training
+quantization replaces each matmul weight with
+
+    q     int8 (or fp8-e4m3), the SAME shape as the fp32 weight
+    scale fp32, the contracted axes collapsed to 1 (per-output-channel
+          symmetric absmax scales, keepdims layout so `q * scale`
+          broadcasts to the dequantized weight exactly)
+
+and the consumers fuse the dequant as an epilogue — `scale * (q @ x)`
+— so the fp32 weight never exists as a device buffer: int8 lives in
+HBM, the upcast happens inside the consuming fusion / kernel tile.
+
+Registered as a pytree node with `q` FIRST: flax's `Scope.param` shape
+check zips `tree_leaves(value)` against the init_fn's abstract output
+pairwise, so the stored value may carry extra trailing leaves (the
+scale) as long as the first leaf has the declared shape — `q` does, by
+construction. tests/test_quant.py pins this leaf order.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# symmetric quantization ranges per storage dtype
+_INT8_MAX = 127.0
+_FP8_E4M3_MAX = 448.0
+
+
+def fp8_dtype():
+    """jnp.float8_e4m3fn where this jax build carries it, else None
+    (the fp8 mixes are gated on this — never a hard import error)."""
+    return getattr(jnp, 'float8_e4m3fn', None)
+
+
+@jax.tree_util.register_pytree_with_keys_class
+class QuantTensor:
+    """One quantized weight: `q` (int8/fp8, the fp32 weight's shape) +
+    `scale` (fp32, contracted axes kept as size-1 dims). `q * scale`
+    broadcasts to the dequantized weight; consumers instead fold the
+    scale in AFTER their contraction (the fused-dequant epilogue)."""
+
+    __slots__ = ('q', 'scale')
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    # pytree protocol — q FIRST (see the module docstring: flax's
+    # param-shape check reads only the first leaf)
+    def tree_flatten_with_keys(self):
+        return (((jax.tree_util.GetAttrKey('q'), self.q),
+                 (jax.tree_util.GetAttrKey('scale'), self.scale)), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # array-protocol surface the engine/rules plumbing reads
+    @property
+    def shape(self):
+        return np.shape(self.q)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def dtype(self):
+        return getattr(self.q, 'dtype', None)
+
+    @property
+    def nbytes(self) -> int:
+        return int(_leaf_nbytes(self.q) + _leaf_nbytes(self.scale))
+
+    def dequant(self, dtype=jnp.float32):
+        """The full-precision weight — as a TRANSIENT value inside a
+        traced program (or a host-side test oracle), never something to
+        store: the whole point is that this product is an epilogue, not
+        a buffer."""
+        return jnp.asarray(self.q).astype(dtype) * jnp.asarray(self.scale)
+
+    def __repr__(self):
+        return (f'QuantTensor(q={self.shape}:{self.dtype}, '
+                f'scale={np.shape(self.scale)})')
+
+
+def _leaf_nbytes(a) -> int:
+    size = int(np.prod(np.shape(a) or (1,)))
+    return size * np.dtype(getattr(a, 'dtype', np.float32)).itemsize
+
+
+def quantize(w, contract_axes: Sequence[int] = (0,),
+             storage: str = 'int8') -> QuantTensor:
+    """Symmetric per-output-channel quantization on HOST numpy (no
+    device placement — restore-time quantization must finish before the
+    first device_put so the fp32 tree never lands in HBM).
+
+    `contract_axes` are the matmul's contracted dims (axis 0 for the
+    [in, out...] weights this repo uses): the absmax reduces over them,
+    every remaining dim keeps its own scale — the error bound is then
+    max|w|/254 per channel for int8 (round-to-nearest of a symmetric
+    127-level grid), pinned by tests/test_quant.py.
+    """
+    w = np.asarray(w, np.float32)
+    axes = tuple(int(a) % w.ndim for a in contract_axes)
+    amax = np.max(np.abs(w), axis=axes, keepdims=True)
+    if storage == 'int8':
+        qmax, dt = _INT8_MAX, np.int8
+    elif storage == 'fp8_e4m3':
+        dt = fp8_dtype()
+        if dt is None:
+            raise ValueError(
+                'fp8_e4m3 storage requested but this jax build has no '
+                'jnp.float8_e4m3fn — use an int8 mix instead')
+        qmax = _FP8_E4M3_MAX
+    else:
+        raise ValueError(f'unknown quant storage {storage!r} '
+                         f"(known: 'int8', 'fp8_e4m3')")
+    scale = amax / qmax
+    # an all-zero channel quantizes to zeros under ANY scale; 1.0 keeps
+    # the divide clean without special-casing dequant
+    scale = np.where(amax == 0.0, 1.0, scale).astype(np.float32)
+    if storage == 'int8':
+        q = np.clip(np.rint(w / scale), -_INT8_MAX, _INT8_MAX)
+        q = q.astype(np.int8)
+    else:
+        q = np.asarray(w / scale).astype(dt)
+    return QuantTensor(q, scale)
+
+
+def dequantize(qt: QuantTensor) -> np.ndarray:
+    """Host-side oracle: the fp32 weight the consumers' fused epilogues
+    are numerically equivalent to (modulo one multiply reassociation)."""
+    return (np.asarray(qt.q, np.float32)
+            * np.asarray(qt.scale, np.float32))
+
+
+def is_quantized(tree) -> bool:
+    """True when any node of `tree` is a QuantTensor (the engine's
+    params setter uses this to skip re-quantizing an already-quantized
+    tree on a weight swap)."""
+    found = False
+
+    def probe(x):
+        nonlocal found
+        if isinstance(x, QuantTensor):
+            found = True
+        return x
+
+    jax.tree_util.tree_map(
+        probe, tree, is_leaf=lambda x: isinstance(x, QuantTensor))
+    return found
+
+
+def concat_weights(ws, axis: int):
+    """Concatenate grouped per-pair radial weights along a NON-contracted
+    axis, preserving quantization: QuantTensors concatenate q and scale
+    along the same axis (the contracted dims are size-1 in the scale, so
+    any concat axis the caller uses here is a per-channel axis in both).
+    A mixed fp32/quantized group dequantizes the quantized members —
+    first-match-wins rules make that configuration unusual, but a silent
+    dtype error would be worse."""
+    ws = list(ws)
+    if not any(isinstance(w, QuantTensor) for w in ws):
+        return jnp.concatenate(ws, axis=axis)
+    if all(isinstance(w, QuantTensor) for w in ws) and len(
+            {np.dtype(w.q.dtype) for w in ws}) == 1:
+        return QuantTensor(
+            jnp.concatenate([w.q for w in ws], axis=axis),
+            jnp.concatenate([w.scale for w in ws], axis=axis))
+    return jnp.concatenate(
+        [w.dequant() if isinstance(w, QuantTensor) else w for w in ws],
+        axis=axis)
+
+
+def weight_or_none(w) -> Tuple[Optional[jnp.ndarray], Optional[jnp.ndarray]]:
+    """(storage, scale) split for kernel plumbing: a QuantTensor yields
+    (q, scale); a plain array yields (w, None)."""
+    if isinstance(w, QuantTensor):
+        return w.q, w.scale
+    return w, None

@@ -1,0 +1,212 @@
+"""Precision-mix rules: ordered regex-on-param-path -> storage precision.
+
+The `parallel.rules` / `conv_backend` idiom applied to serving
+precision: an ordered list of ``(regex, precision[, ndim])`` rules
+matched against the '/'-joined flax param path, FIRST MATCH WINS
+(a rank-guarded rule only matches leaves of that rank — LinearSE3's
+higher-degree mixers share the `w<d>` names with the radial weights,
+so the guard is what keeps a num_degrees>=4 model's 2-d ``w3`` MIXER
+out of the 3-d radial-``w3`` int8 class), with precisions
+
+    'int8'      symmetric per-output-channel int8 (QuantTensor)
+    'fp8_e4m3'  fp8 storage where the dtype exists (QuantTensor)
+    'bf16'      plain bfloat16 cast (consumers promote back to f32)
+    'fp32'      passthrough
+
+The split the physics demands (ROADMAP item 3 / EquiformerV2): int8 is
+restricted to the INVARIANT-INPUT matmuls — degree-0 LinearSE3 channel
+mixers (`w0`: FF project_in/out, attention to_q/to_out/to_self_*,
+self_interact), the radial matmul weights (`w3` / grouped
+`w3_{din}_{dout}` — where the bytes are, shared by the dense AND so2
+backends), and the radial trunk's Dense kernels. Their inputs are
+rotation-invariant scalars, so weight quantization error cancels in
+the equivariance measurement. Higher-degree (l>0) channel mixers get a
+bf16 PASSTHROUGH at most: rotation error compounds on exactly those
+paths, and a rule that assigns int8/fp8 to one raises
+`EquivariantPrecisionError` LOUDLY (never a silent accuracy cliff) —
+the negative test in tests/test_quant.py pins it.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+from .qtensor import QuantTensor, fp8_dtype, quantize
+
+PRECISIONS = ('int8', 'fp8_e4m3', 'bf16', 'fp32')
+
+# (regex, precision) or (regex, precision, required_ndim); matched
+# against the '/'-joined param path, first match wins, implicit
+# ('.*', 'fp32') tail. The rank guard is the parallel.rules idiom: a
+# name-match with the wrong rank falls through to the NEXT rule —
+# load-bearing here because LinearSE3's higher-degree channel mixers
+# are ALSO named w<d> (a num_degrees>=4 model has a 2-d `w3` mixer
+# that must never collide with the 3-d radial `w3` weights).
+PrecisionRule = Union[Tuple[str, str], Tuple[str, str, int]]
+PrecisionRules = Sequence[PrecisionRule]
+MixSpec = Union[str, PrecisionRules]
+
+# the invariant-input matmul weight classes int8/fp8 storage is safe
+# for (weight error on these paths shifts ACCURACY, not equivariance —
+# their inputs are rotation-invariant scalars / degree-0 features),
+# each with the rank that identifies it:
+#   w0 [in, out]           degree-0 LinearSE3 channel mixers
+#   w3 / w3_i_o [m, IF, O] radial matmul weights (dense + so2 + flash)
+#   Dense_0/1 kernel       the radial trunk's hidden matmuls
+_W0_RE = r'(^|/)w0$'
+_W3_RE = r'(^|/)w3(_\d+_\d+)?$'
+_RADIAL_DENSE_RE = r'(^|/)Dense_[01]/kernel$'
+_INT8_SAFE = ((_W0_RE, 2), (_W3_RE, 3), (_RADIAL_DENSE_RE, 2))
+
+# higher-degree LinearSE3 channel mixers: bf16 at most (this also
+# catches a 2-d `w3` MIXER after the rank guard rejects it above)
+_WL_RE = r'(^|/)w[1-9]\d*$'
+
+
+class EquivariantPrecisionError(ValueError):
+    """An int8/fp8 rule matched a param outside the invariant-safe
+    class — the l>0 accuracy cliff the precision layer exists to avoid."""
+
+
+def _mix_rules(low: str) -> PrecisionRules:
+    return (
+        (_W0_RE, low, 2),
+        (_W3_RE, low, 3),
+        (_RADIAL_DENSE_RE, low, 2),
+        (_WL_RE, 'bf16'),
+        (r'.*', 'fp32'),
+    )
+
+
+# shipped mixes — norms / biases / embeddings / gates stay fp32 in all
+# of them (tiny, and several feed non-matmul consumers)
+MIXES: Dict[str, PrecisionRules] = {
+    'fp32': ((r'.*', 'fp32'),),
+    'bf16': _mix_rules('bf16'),
+    'int8_mix': _mix_rules('int8'),
+    'fp8_mix': _mix_rules('fp8_e4m3'),
+}
+
+
+def resolve_mix(mix: MixSpec) -> PrecisionRules:
+    """A mix by name or an explicit rule list, normalized. `fp8_mix`
+    additionally requires the fp8 dtype to exist in this jax build."""
+    if isinstance(mix, str):
+        if mix not in MIXES:
+            raise KeyError(f'unknown precision mix {mix!r} '
+                           f'(shipped: {sorted(MIXES)})')
+        if mix == 'fp8_mix' and fp8_dtype() is None:
+            raise ValueError(
+                "precision mix 'fp8_mix' needs jnp.float8_e4m3fn, which "
+                "this jax build does not carry — use 'int8_mix'")
+        return MIXES[mix]
+    rules = tuple(mix)
+    for rule in rules:
+        prec = rule[1]
+        if prec not in PRECISIONS:
+            raise ValueError(f'rule ({rule[0]!r}, {prec!r}): precision '
+                             f'must be one of {PRECISIONS}')
+    return rules
+
+
+def mix_name(mix: MixSpec) -> str:
+    return mix if isinstance(mix, str) else 'custom'
+
+
+def resolve_precision(rules: PrecisionRules, path: str,
+                      ndim: int = None) -> str:
+    """First-match-wins precision for one param path ('fp32' tail). A
+    rule carrying a rank guard only matches leaves of that rank —
+    otherwise scanning continues (the parallel.rules semantics)."""
+    for rule in rules:
+        pat, prec = rule[0], rule[1]
+        guard = rule[2] if len(rule) > 2 else None
+        if guard is not None and ndim is not None and ndim != guard:
+            continue
+        if re.search(pat, path):
+            return prec
+    return 'fp32'
+
+
+def _path_of(key_path) -> str:
+    parts = []
+    for k in key_path:
+        parts.append(str(getattr(k, 'key', getattr(k, 'name', k))))
+    return '/'.join(parts)
+
+
+def quantize_params(params, mix: MixSpec = 'int8_mix'):
+    """Convert a restored (host) params pytree into its quantized form.
+
+    Returns ``(qparams, report)``: the tree with int8/fp8 leaves as
+    QuantTensor nodes (contracted axis 0 per-output-channel scales),
+    bf16 leaves cast, everything else passed through — same tree paths,
+    so `module.apply` and the partition-rule engine walk it unchanged.
+    Runs on HOST numpy: the caller device_puts the RESULT, which is how
+    the engine guarantees the fp32 degree-0 weights never materialize
+    on device (test-pinned).
+
+    `report` is the JSON-safe before/after ledger (per-precision leaf
+    counts and bytes, the argument-bytes ratio) that rides the engine's
+    `cost`/`serve` records.
+    """
+    rules = resolve_mix(mix)
+    counts = {p: 0 for p in PRECISIONS}
+    bytes_before = 0
+    bytes_after = 0
+    offenders = []
+
+    def convert(key_path, leaf):
+        nonlocal bytes_before, bytes_after
+        path = _path_of(key_path)
+        arr = np.asarray(leaf)
+        nbytes = int(arr.size * arr.dtype.itemsize)
+        bytes_before += nbytes
+        if not np.issubdtype(arr.dtype, np.floating):
+            bytes_after += nbytes
+            return leaf
+        prec = resolve_precision(rules, path, ndim=arr.ndim)
+        counts[prec] += 1
+        if prec == 'fp32':
+            bytes_after += nbytes
+            return leaf
+        if prec == 'bf16':
+            # host-side cast (ml_dtypes, the same bfloat16 jnp uses):
+            # the quantization pass must never touch a device — the
+            # caller's single device_put is the only transfer
+            import ml_dtypes
+            out = arr.astype(ml_dtypes.bfloat16)
+            bytes_after += int(arr.size * 2)
+            return out
+        # int8 / fp8: the invariant-safe guard first — an equivariant
+        # (l>0) weight matched by a low-precision rule is a config
+        # error, not a quantization target. Rank-checked: a 2-d `w3`
+        # is a higher-degree LinearSE3 MIXER, not the radial weight
+        if not any(re.search(p, path) and arr.ndim == nd
+                   for p, nd in _INT8_SAFE):
+            offenders.append((path, prec))
+            return leaf
+        qt = quantize(arr, contract_axes=(0,), storage=prec)
+        bytes_after += qt.nbytes
+        return qt
+
+    qparams = jax.tree_util.tree_map_with_path(convert, params)
+    if offenders:
+        shown = ', '.join(f'{p} -> {prec}' for p, prec in offenders[:8])
+        raise EquivariantPrecisionError(
+            f'{len(offenders)} param(s) outside the invariant-safe '
+            f'weight classes matched an int8/fp8 rule ({shown}'
+            f'{" ..." if len(offenders) > 8 else ""}) — higher-degree '
+            f'kernels compound rotation error and may go bf16 at most '
+            f'(see quant.rules)')
+    report = dict(
+        mix=mix_name(mix),
+        leaves={p: n for p, n in counts.items() if n},
+        params_bytes_fp32=int(bytes_before),
+        params_bytes_quantized=int(bytes_after),
+        bytes_ratio=round(bytes_after / max(bytes_before, 1), 4),
+    )
+    return qparams, report

@@ -423,8 +423,13 @@ class ReplicaWorker:
             self.executor.shutdown(wait=True)
 
     def snapshot(self) -> dict:
-        """Per-replica depth/served/swap counters for the serve record."""
+        """Per-replica depth/served/swap counters for the serve record.
+        `precision` surfaces the engine's weight-precision mix — the
+        router accepts replicas built at DIFFERENT mixes (heterogeneous
+        serving), so the record must say which replica ran which."""
         return dict(depth=self.batcher.depth,
+                    precision=getattr(self.engine, 'precision_name',
+                                      'fp32'),
                     served=self.served_rows,
                     batches=self.batcher.batches_dispatched,
                     continuous_admissions=self.batcher.continuous_admissions,

@@ -80,6 +80,12 @@ class RouterTelemetry(ServeTelemetryBase):
         router = self.router
         return dict(
             replicas={str(w.id): w.snapshot() for w in router.workers},
+            # the fleet's precision mixes at a glance (heterogeneous
+            # serving: replicas may run different quant mixes; the
+            # per-replica value is in each snapshot)
+            precision_mixes=sorted({
+                getattr(w.engine, 'precision_name', 'fp32')
+                for w in router.workers}),
             swaps=dict(count=len(router.swap_events),
                        events=list(router.swap_events)),
             continuous_admissions=router.continuous_admissions,

@@ -66,6 +66,14 @@ class DenoiseConfig:
     # partition radial/head weights over the mesh's tp axis (see
     # parallel.sharding.param_partition_specs); requires a mesh with tp>1
     tensor_parallel: bool = False
+    # true FSDP (ROADMAP item 4's named next step): shard params AND
+    # adam's mu/nu dim-0 over the mesh's dp axis (parallel.rules fsdp
+    # set + shard_opt_state — the moments inherit their param's audited
+    # spec), and build the step with sharded_state=True so the update
+    # runs shard-local and the donated state aliases in place. Before
+    # this knob, opt state replicated on every chip (2x param memory)
+    # despite the PR 10 specs existing. Requires a mesh with dp>1.
+    fsdp: bool = False
     log_every: int = 1
     # first-class telemetry (observability package): thread an on-device
     # MetricAccumulator through the jitted step (zero host syncs on hot
@@ -182,6 +190,8 @@ class DenoiseTrainer:
         self.loss_fn = denoise_loss_fn(self.module)
         self.tensor_parallel = bool(cfg.tensor_parallel
                                     and self.mesh is not None)
+        self.fsdp = bool(cfg.fsdp and self.mesh is not None)
+        self.opt_state_specs = None   # filled by init()/restore() (fsdp)
         if cfg.tensor_parallel and (
                 self.mesh is None or self.mesh.shape.get('tp', 1) == 1):
             import warnings
@@ -190,19 +200,16 @@ class DenoiseTrainer:
                 '(make_mesh defaults tp=1) — params will be fully '
                 'replicated; build the mesh with make_mesh(tp=...) to '
                 'actually partition them', stacklevel=2)
-        if cfg.accum_steps > 1:
-            # reference denoise.py:13,55: 16 micro-batches per update
-            self._step_fn = make_accumulating_train_step(
-                self.loss_fn, self.optimizer, cfg.accum_steps,
-                mesh=self.mesh, donate_batch=cfg.donate_batch,
-                tensor_parallel=self.tensor_parallel,
-                telemetry=cfg.telemetry)
-        else:
-            self._step_fn = make_sharded_train_step(
-                self.loss_fn, self.optimizer, mesh=self.mesh,
-                donate_batch=cfg.donate_batch,
-                tensor_parallel=self.tensor_parallel,
-                telemetry=cfg.telemetry)
+        if cfg.fsdp and (
+                self.mesh is None or self.mesh.shape.get('dp', 1) == 1):
+            import warnings
+            warnings.warn(
+                'fsdp=True but the mesh has no dp axis > 1 — params '
+                'and optimizer state will end up replicated (the fsdp '
+                'rule set demotes indivisible dims); build the mesh '
+                'with make_mesh(dp=...) to actually shard them',
+                stacklevel=2)
+        self._step_fn = self._make_step()
         self.np_rng = np.random.RandomState(cfg.seed)
         self.rng = jax.random.PRNGKey(cfg.seed)
         self.params = None
@@ -229,6 +236,35 @@ class DenoiseTrainer:
             # but still pays the compile on its first dispatch
             self._warmed_up = False
 
+    def _make_step(self, state_shardings=None):
+        """Build the jitted step (factored so the fsdp path can REBUILD
+        it once placements exist — state_shardings pins in/out
+        shardings to the placed state, the explicit-aliasing route
+        around the jax-0.4.37 GSPMD donation bug; see
+        parallel.sharding.make_sharded_train_step)."""
+        cfg = self.cfg
+        kwargs = dict(mesh=self.mesh, donate_batch=cfg.donate_batch,
+                      tensor_parallel=self.tensor_parallel,
+                      sharded_state=self.fsdp,
+                      state_shardings=state_shardings,
+                      telemetry=cfg.telemetry)
+        if cfg.accum_steps > 1:
+            # reference denoise.py:13,55: 16 micro-batches per update
+            return make_accumulating_train_step(
+                self.loss_fn, self.optimizer, cfg.accum_steps, **kwargs)
+        return make_sharded_train_step(
+            self.loss_fn, self.optimizer, **kwargs)
+
+    def _pin_fsdp_step(self):
+        """Rebuild the step with in/out shardings pinned to the placed
+        params/opt-state (called from init()/restore() under fsdp)."""
+        shardings = tuple(
+            jax.tree_util.tree_map(lambda leaf: leaf.sharding, tree)
+            for tree in (self.params, self.opt_state))
+        self._step_fn = self._make_step(state_shardings=shardings)
+        if self.watchdog is not None:
+            self.watchdog.track('train_step', self._step_fn)
+
     def init(self, batch=None):
         batch = batch if batch is not None else synthetic_protein_batch(
             self.cfg, self.np_rng)
@@ -239,7 +275,20 @@ class DenoiseTrainer:
         self.params = init_fn(
             sub, batch['seqs'], noised, mask=batch['masks'],
             adj_mat=batch['adj_mat'], return_type=1)['params']
-        if self.tensor_parallel:
+        if self.fsdp:
+            # true FSDP: params dim-0 over dp (fsdp rule set), then the
+            # optimizer state through shard_opt_state so adam's mu/nu
+            # inherit each param's AUDITED spec — the step factory's
+            # sharded_state=True keeps both placements through the
+            # update (nothing re-replicates, donation aliases in place)
+            from ..parallel.rules import shard_opt_state
+            from ..parallel.sharding import shard_params
+            self.params = shard_params(self.params, self.mesh,
+                                       rules='fsdp')
+            self.opt_state, self.opt_state_specs = shard_opt_state(
+                self.optimizer.init(self.params), self.params, self.mesh)
+            self._pin_fsdp_step()
+        elif self.tensor_parallel:
             from ..parallel.sharding import shard_params
             self.params = shard_params(self.params, self.mesh)
             # jit so the adam moments inherit the param placement (eager
@@ -248,6 +297,33 @@ class DenoiseTrainer:
         else:
             self.opt_state = self.optimizer.init(self.params)
         return self.params
+
+    def restore(self, state) -> None:
+        """Adopt a restored (params, opt_state, step_count) checkpoint
+        tuple, RE-PLACING it under the trainer's sharding config:
+        orbax/pickle restores hand back host (or replicated) leaves,
+        and a resumed fsdp run must land mu/nu back in their dim-0
+        shards — not replicate 2x the param memory on every chip until
+        the first step reshards them implicitly."""
+        params, opt_state, step_count = state
+        if self.fsdp:
+            from ..parallel.rules import shard_opt_state
+            from ..parallel.sharding import shard_params
+            params = shard_params(params, self.mesh, rules='fsdp')
+            opt_state, self.opt_state_specs = shard_opt_state(
+                opt_state, params, self.mesh)
+            self.params, self.opt_state = params, opt_state
+            self.step_count = int(step_count)
+            self._pin_fsdp_step()
+            return
+        elif self.tensor_parallel:
+            from ..parallel.rules import shard_opt_state
+            from ..parallel.sharding import shard_params
+            params = shard_params(params, self.mesh)
+            opt_state, _ = shard_opt_state(opt_state, params, self.mesh,
+                                           rules='tp')
+        self.params, self.opt_state = params, opt_state
+        self.step_count = int(step_count)
 
     def train_step(self, batch, preplaced: bool = False) -> jax.Array:
         """One optimizer update. With accum_steps > 1 the batch leaves must

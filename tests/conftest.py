@@ -118,6 +118,16 @@ _HEAVY_TESTS = {
     'test_sharded_matches_replicated_outputs',
     'test_sharded_padded_matches_unpadded_single_request',
     'test_sharded_engine_zero_post_warmup_compiles_across_swap',
+    # quant tier (PR 13): the model-level fused-epilogue oracles and
+    # the multi-engine restore/parity guard compile several toy
+    # programs each; the pure quantize/schema unit tests stay fast
+    'test_quantized_apply_matches_dequant_oracle',
+    'test_so2_backend_quantized_matches_dequant_oracle',
+    'test_flash_fused_pairwise_quantized_matches_unfused',
+    'test_quantized_equivariance_degrees_2_4',
+    'test_engine_restore_time_quantization_and_mix_parity',
+    'test_engine_fp8_mix_if_available',
+    'test_fsdp_sharded_opt_state_train_and_restore',
 }
 
 

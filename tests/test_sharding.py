@@ -390,3 +390,41 @@ def test_checkpoint_roundtrip_preserves_shardings():
     assert np.allclose(np.asarray(restored['bias']),
                        np.asarray(state['bias']))
     assert int(restored['step']) == 7
+
+
+def test_fsdp_sharded_opt_state_train_and_restore():
+    """True-FSDP wiring (ROADMAP item 4's named next step): with
+    cfg.fsdp the trainer shards params AND adam's mu/nu dim-0 over dp
+    (shard_opt_state — the moments inherit each param's audited spec),
+    the step factory pins in/out shardings to those placements (the
+    explicit-aliasing route around the jax-0.4.37 GSPMD donation bug),
+    and a host-roundtripped checkpoint restores BACK into the shards —
+    never replicated 2x param memory until the first step."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(dp=2)
+    cfg = DenoiseConfig(num_nodes=24, batch_size=2, num_degrees=2,
+                        max_sparse_neighbors=4, use_mesh=True, fsdp=True)
+    tr = DenoiseTrainer(cfg, mesh=mesh)
+    batch = synthetic_protein_batch(cfg, tr.np_rng)
+    tr.init(batch)
+
+    def mu_leaf(state):
+        return state[0].mu['conv_in']['pair_0_0']['w3']
+
+    assert mu_leaf(tr.opt_state).sharding.spec == P('dp')
+    l1 = float(tr.train_step(batch))
+    l2 = float(tr.train_step(batch))
+    assert np.isfinite(l1) and np.isfinite(l2)
+    # the donated sharded state stays sharded through the update
+    assert mu_leaf(tr.opt_state).sharding.spec == P('dp')
+    assert tr.params['conv_in']['pair_0_0']['w3'].sharding.spec == \
+        P('dp')
+
+    # checkpoint-restore path: host leaves re-place into their shards
+    host = jax.tree_util.tree_map(
+        np.asarray, (tr.params, tr.opt_state, tr.step_count))
+    tr.restore(host)
+    assert mu_leaf(tr.opt_state).sharding.spec == P('dp')
+    l3 = float(tr.train_step(batch))
+    assert np.isfinite(l3)

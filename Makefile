@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke flash-smoke chaos-smoke quant-smoke perf-gate clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke flash-smoke chaos-smoke train-chaos-smoke quant-smoke perf-gate clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -78,6 +78,13 @@ chaos-smoke:       ## fault-domain gate (docs/ROBUSTNESS.md): seeded replica cra
 	python scripts/obs_report.py /tmp/chaos_smoke.jsonl --validate --require fault,serve --out /tmp/chaos_smoke_report.json
 	python scripts/perf_gate.py /tmp/chaos_smoke.jsonl
 	python scripts/chaos_smoke.py --weaken drop >/tmp/chaos_weaken.log 2>&1; test $$? -eq 1 || { echo "chaos-smoke weakened arm did NOT fire with rc=1 — a droppable fault class went undetected; output:"; cat /tmp/chaos_weaken.log; exit 1; }  # rc=1 is the gate FIRING on lost requests; any other rc (crash, argparse) fails loudly with the evidence
+
+train-chaos-smoke: ## self-healing training gate (docs/ROBUSTNESS.md "Training fault domain"): an injected-NaN step + a real mid-run SIGTERM over the guarded elastic loop — the run must roll back (>=1 observed), exit resumable, resume, and finish BIT-EXACT vs an uninterrupted control arm with zero post-warmup recompiles; schema'd guard records (--require guard: injections >= 1, diverged == false), judged by the train-chaos perf budgets; then the WEAKENED arm (rollback nulled) must exit rc==1, proving the diverged gate fires
+	rm -f /tmp/train_chaos.jsonl
+	python scripts/train_chaos_smoke.py --metrics /tmp/train_chaos.jsonl --out /tmp/train_chaos_summary.json
+	python scripts/obs_report.py /tmp/train_chaos.jsonl --validate --require guard --out /tmp/train_chaos_report.json
+	python scripts/perf_gate.py /tmp/train_chaos.jsonl
+	python scripts/train_chaos_smoke.py --weaken norollback >/tmp/train_chaos_weaken.log 2>&1; test $$? -eq 1 || { echo "train-chaos-smoke weakened arm did NOT fire with rc=1 — a nulled rollback went undetected; output:"; cat /tmp/train_chaos_weaken.log; exit 1; }  # rc=1 is the diverged gate FIRING; any other rc (crash, argparse) fails loudly with the evidence
 
 quant-smoke:       ## CPU quantized-serving gate (docs/PERFORMANCE.md "Quantized serving"): fp32 + int8-mix AOT engines from ONE param tree — implementation parity <=1e-4 (padded+unpadded, vs the fp32 reference of the same quantized weights), equivariance-L2 <=1e-4 at degrees 2/4, argument-bytes <=0.6x fp32 off the cost ledger, schema'd quant_ab record banked and judged by the committed quant perf budgets
 	rm -f /tmp/quant_smoke.jsonl

@@ -62,6 +62,22 @@ def main():
     ap.add_argument('--dataset', type=str, default=None,
                     help='train from a PointCloudDataset .npz (see '
                          'training.dataset); --nodes becomes the bucket size')
+    ap.add_argument('--guarded', action='store_true',
+                    help='self-healing elastic loop (training.guardian, '
+                         'docs/ROBUSTNESS.md "Training fault domain"): '
+                         'NaN/spike windows roll back to the newest '
+                         'restorable checkpoint and replay '
+                         'deterministically, SIGTERM/SIGINT triggers one '
+                         'synchronous emergency save and a resumable '
+                         'exit (rc 75), and a schema\'d guard record is '
+                         'banked; requires --ckpt-dir, implies '
+                         '--telemetry (gate: make train-chaos-smoke)')
+    ap.add_argument('--restart-budget', type=int, default=3,
+                    help='guarded: rollbacks allowed before failing '
+                         'loud with a structured TrainingFailed')
+    ap.add_argument('--spike-zscore', type=float, default=8.0,
+                    help='guarded: EMA z-score above which a window\'s '
+                         'loss mean counts as a spike')
     ap.add_argument('--cpu', action='store_true',
                     help='force the CPU backend (the axon TPU tunnel is '
                          'single-client and BLOCKS at init when wedged or '
@@ -72,6 +88,15 @@ def main():
         import jax
         jax.config.update('jax_platforms', 'cpu')
 
+    if args.guarded:
+        assert args.ckpt_dir, '--guarded needs --ckpt-dir (the rollback ' \
+            'target and the preemption resume point live there)'
+        assert not args.dataset, \
+            '--guarded trains on per-step-index synthetic batches ' \
+            '(deterministic replay is what makes rollback/resume ' \
+            'bit-exact); a dataset-backed guarded loop needs a ' \
+            'step-indexed batch source and is not wired yet'
+        args.telemetry = True      # detection rides the accumulator
     cfg = DenoiseConfig(num_nodes=args.nodes, batch_size=args.batch,
                         num_degrees=args.degrees, use_mesh=args.mesh,
                         accum_steps=args.accum, telemetry=args.telemetry,
@@ -86,7 +111,8 @@ def main():
     trainer = DenoiseTrainer(cfg)
 
     ckpt = CheckpointManager(args.ckpt_dir) if args.ckpt_dir else None
-    if ckpt is not None and ckpt.latest_step() is not None:
+    if ckpt is not None and ckpt.latest_step() is not None \
+            and not args.guarded:
         trainer.init()
         state = ckpt.restore(like=(trainer.params, trainer.opt_state,
                                    trainer.step_count))
@@ -101,6 +127,30 @@ def main():
     # context-managed: the file handle closes on EVERY exit path (the old
     # happy-path-only close() leaked it on exceptions)
     with MetricLogger(args.metrics, run_meta=run_meta) as logger:
+        if args.guarded:
+            import sys
+
+            from se3_transformer_tpu.training.guardian import (
+                GuardConfig, StepGuard, resume_trainer,
+            )
+            # guarded resume uses the guardian's donation-safe restore
+            # normalization (fresh uncommitted buffers — no post-warmup
+            # recompile, no aliasing of the restored arrays)
+            restart = ckpt.latest_step() is not None
+            if restart:
+                print(f'guarded resume from step '
+                      f'{resume_trainer(trainer, ckpt)}')
+            guard = StepGuard(GuardConfig(
+                restart_budget=args.restart_budget,
+                spike_zscore=args.spike_zscore))
+            result = trainer.train_guarded(
+                args.steps, ckpt, guard=guard, metric_logger=logger,
+                restart=restart)
+            if result.exit_code:
+                # 75 = preempted-resumable (a supervisor restarts),
+                # 1 = diverged (fail loud)
+                sys.exit(result.exit_code)
+            return result.history
         if args.pipelined:
             batch_source = None
             if args.dataset:

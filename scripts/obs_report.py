@@ -225,6 +225,35 @@ def _gate_fault(records):
     return True
 
 
+def _gate_guard(records):
+    guards = [r for r in records if r.get('kind') == 'guard']
+    if not guards:
+        print('GUARD GATE: no guard records in the stream (was the run '
+              'trained through the guardian — train_guarded / '
+              'scripts/train_chaos_smoke.py?)', file=sys.stderr)
+        return False
+    last = guards[-1]
+    if not last.get('injections_total'):
+        print('GUARD GATE: zero injections in the final guard record — '
+              'a guard record that exercised nothing proves nothing',
+              file=sys.stderr)
+        return False
+    if last.get('diverged') is not False:
+        print(f'GUARD GATE: diverged={last.get("diverged")!r} — the '
+              f'guarded run must end on finite, policy-clean '
+              f'parameters (rollback paid every trip down)',
+              file=sys.stderr)
+        return False
+    print(f"guard gate ok: {len(guards)} guard records, "
+          f"{last['injections_total']} injections, "
+          f"{last.get('trips', 0)} trips / "
+          f"{last.get('rollbacks', 0)} rollbacks / "
+          f"{last.get('restarts', 0)} restarts / "
+          f"{last.get('preemptions', 0)} preemptions, not diverged",
+          file=sys.stderr)
+    return True
+
+
 def _gate_so2_sweep(records):
     sweeps = [r for r in records if r.get('kind') == 'so2_sweep']
     if not sweeps:
@@ -326,7 +355,8 @@ _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       tune=_gate_tune, cost=_gate_cost,
                       profile=_gate_profile, serve=_gate_serve,
                       so2_sweep=_gate_so2_sweep, flash=_gate_flash,
-                      fault=_gate_fault, quant_ab=_gate_quant_ab)
+                      fault=_gate_fault, guard=_gate_guard,
+                      quant_ab=_gate_quant_ab)
 
 
 def main(argv=None):
@@ -353,8 +383,9 @@ def main(argv=None):
                          'present with its coverage figure; serve: '
                          'per-bucket latency percentiles present and '
                          'a nonzero answered count; fault: injections '
-                         'present and zero lost requests) and exits '
-                         'non-zero on failure')
+                         'present and zero lost requests; guard: '
+                         'injections present and diverged == false) '
+                         'and exits non-zero on failure')
     # legacy aliases for the unified --require flag (kept: Makefiles and
     # session scripts in the wild still pass them)
     ap.add_argument('--require-tune', action='store_true',

@@ -72,10 +72,13 @@ DEFAULT_BUDGETS = os.path.join(REPO, 'PERF_BUDGETS.json')
 # QUANT_AB.jsonl: the banked `make quant-smoke` fp32-vs-int8-mix serving
 # A/B, so the argument-bytes ceiling, the implementation-parity gate,
 # and the quantized equivariance gate are judged too.
+# TRAIN_CHAOS.jsonl: the banked `make train-chaos-smoke` self-healing
+# training stream, so the zero-divergence contract, the observed
+# rollback, and the nonzero-injections proof bit are judged too.
 DEFAULT_RECORDS = ('BENCH_r05.json', 'WIDTH_TABLE.jsonl',
                    'SERVE_MULTI.jsonl', 'SO2_SWEEP.jsonl',
                    'FLASH_AB.jsonl', 'CHAOS_SMOKE.jsonl',
-                   'QUANT_AB.jsonl')
+                   'QUANT_AB.jsonl', 'TRAIN_CHAOS.jsonl')
 
 
 # --------------------------------------------------------------------- #

@@ -1,0 +1,388 @@
+"""Train-chaos smoke: the self-healing training loop under fire.
+
+Usage:
+    python scripts/train_chaos_smoke.py [--steps 24] [--window 4]
+        [--nodes 32] [--accum 2] [--seed 0] [--pipelined/--no-pipelined]
+        [--metrics TRAIN_CHAOS.jsonl] [--out SUMMARY.json]
+        [--weaken none|norollback] [--workdir DIR]
+
+The serving-side `make chaos-smoke` proves replicas heal; this gate
+proves the TRAINING loop does (docs/ROBUSTNESS.md "Training fault
+domain"). Four arms, three of them subprocesses so the kill is a real
+SIGTERM against a real process:
+
+  1. CONTROL    — the same config runs `--steps` guarded steps with NO
+     faults and banks its final params (the parity oracle).
+  2. CHAOS      — a seeded injector poisons one step's batch with NaN
+     (`step_batch` nan plan: a genuine non-finite loss walks the real
+     jitted step), sleeps on a periodic `step_dispatch` latency plan,
+     and kills the EMERGENCY writer (`emergency_save` exception plan).
+     The guard must detect the NaN window off the telemetry
+     accumulator, roll back to the last good checkpoint, and replay;
+     mid-run the parent sends SIGTERM and the process must exit with
+     the resumable rc (75) — with its emergency save dead, the restart
+     falls back to the last periodic checkpoint.
+  3. RESUME     — a fresh process restores (fallback-aware), survives a
+     SECOND injected NaN (at= indices are per-process call counts, so
+     replay after its rollback is clean), finishes, and banks the
+     cumulative `guard` record (counters carry over the kill through
+     the guardian sidecar).
+  4. (--weaken norollback) — detection with the ROLLBACK NULLED: the
+     NaN window trips but nothing restores, the run ends on NaN params,
+     and this script MUST exit rc==1 (the diverged gate fires rather
+     than decorates). `make train-chaos-smoke` asserts the rc pair.
+
+Exit is non-zero unless ALL of:
+  * the chaos arm exited with the RESUMABLE rc after the SIGTERM;
+  * final params of the resumed run are BIT-EXACT equal to the control
+    arm's (rollback + per-step-derived batches/rngs replay the exact
+    trajectory a never-faulted run walks);
+  * >= 1 rollback was OBSERVED (cumulative guard record) and
+    injections_total >= 1 with diverged == false;
+  * zero post-warmup recompiles in the resumed process (its summary
+    record's retrace_warnings_total — restore must not change shapes);
+  * the telemetry stream (flush/pipeline/guard/summary) is
+    schema-valid.
+"""
+import argparse
+import atexit
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESUMABLE_RC = 75
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description='seeded fault injection over the self-healing '
+                    'training loop (CPU)')
+    ap.add_argument('--steps', type=int, default=24)
+    ap.add_argument('--window', type=int, default=4,
+                    help='guard window = telemetry flush interval')
+    ap.add_argument('--nodes', type=int, default=32)
+    ap.add_argument('--accum', type=int, default=2)
+    ap.add_argument('--seed', type=int, default=0)
+    ap.add_argument('--nan-at', type=int, default=6,
+                    help='chaos arm: poison the Nth built batch')
+    ap.add_argument('--resume-nan-at', type=int, default=4,
+                    help='resume arm: poison its Nth built batch')
+    ap.add_argument('--kill-after-step', type=int, default=None,
+                    help='SIGTERM once the chaos arm reports this step '
+                         '(default: steps // 2)')
+    ap.add_argument('--pipelined', dest='pipelined', action='store_true',
+                    default=True,
+                    help='guarded loop over the producer/prefetch data '
+                         'path (default)')
+    ap.add_argument('--no-pipelined', dest='pipelined',
+                    action='store_false')
+    ap.add_argument('--metrics', type=str, default=None)
+    ap.add_argument('--out', type=str, default=None)
+    ap.add_argument('--workdir', type=str, default=None,
+                    help='checkpoint/params scratch dir (default: a '
+                         'fresh temp dir, removed after)')
+    ap.add_argument('--weaken', choices=('none', 'norollback'),
+                    default='none',
+                    help="'norollback': the guard detects but never "
+                         'restores — the diverged gate MUST fire '
+                         '(rc 1), proving it is live')
+    ap.add_argument('--worker', choices=('control', 'chaos', 'resume'),
+                    default=None, help=argparse.SUPPRESS)
+    ap.add_argument('--progress-file', type=str, default=None,
+                    help=argparse.SUPPRESS)
+    return ap.parse_args(argv)
+
+
+# --------------------------------------------------------------------- #
+# worker arms (run as subprocesses so SIGTERM/exit codes are real)
+# --------------------------------------------------------------------- #
+def _build_trainer(args):
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    from se3_transformer_tpu.training import DenoiseConfig, DenoiseTrainer
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    enable_compilation_cache()
+    cfg = DenoiseConfig(num_nodes=args.nodes, batch_size=1,
+                        num_degrees=2, max_sparse_neighbors=4,
+                        accum_steps=args.accum, seed=args.seed,
+                        telemetry=True, flush_every=args.window,
+                        pipeline=args.pipelined,
+                        donate_batch=args.pipelined)
+    return DenoiseTrainer(cfg)
+
+
+def _dump_params(trainer, path):
+    import jax
+    import numpy as np
+    leaves, _ = jax.tree_util.tree_flatten(trainer.params)
+    np.savez(path, *[np.asarray(l) for l in leaves])
+
+
+def worker_main(args):
+    import numpy as np  # noqa: F401 - jax platform pinned in builder
+
+    from se3_transformer_tpu.faults import FaultInjector
+    from se3_transformer_tpu.observability import MetricLogger
+    from se3_transformer_tpu.training.guardian import (
+        GuardConfig, StepGuard, TrainingFailed, resume_trainer,
+    )
+    from se3_transformer_tpu.training.checkpoint import CheckpointManager
+
+    trainer = _build_trainer(args)
+    ckpt_dir = os.path.join(args.workdir, 'ckpt')
+    params_path = os.path.join(args.workdir, f'params_{args.worker}.npz')
+
+    inj = None
+    if args.worker != 'control':
+        inj = FaultInjector(seed=args.seed)
+        nan_at = (args.nan_at if args.worker == 'chaos'
+                  else args.resume_nan_at)
+        # at= counts BUILT batches in this process — builds are strictly
+        # ordered on the producer thread, so the poisoned step is
+        # deterministic; replay after the rollback fires calls past the
+        # plan, so the replayed window is clean (parity holds)
+        inj.plan('step_batch', 'nan', at=(nan_at,))
+        inj.plan('step_dispatch', 'latency', every=9, latency_s=0.005)
+        if args.worker == 'chaos':
+            # the EMERGENCY writer dies too: the preemption exit must
+            # still be resumable, falling back to the last periodic
+            # checkpoint
+            inj.plan('emergency_save', 'exception', at=(1,))
+
+    guard = StepGuard(GuardConfig(
+        rollback=(args.weaken != 'norollback'), restart_budget=4))
+    mgr = CheckpointManager(ckpt_dir, max_to_keep=3)
+    restart = args.worker == 'resume'
+    if restart:
+        restored = resume_trainer(trainer, mgr)
+        print(f'resume worker: restored step {restored} '
+              f'(last_restored_step={mgr.last_restored_step})')
+
+    progress = None
+    if args.progress_file:
+        def progress(step):  # noqa: E306
+            tmp = args.progress_file + '.tmp'
+            with open(tmp, 'w') as f:
+                f.write(str(step))
+            os.replace(tmp, args.progress_file)
+
+    run_meta = dict(mode='train_chaos_smoke', arm=args.worker,
+                    weaken=args.weaken, pipelined=args.pipelined,
+                    steps=args.steps, window=args.window, seed=args.seed)
+    logger = (MetricLogger(args.metrics, run_meta=run_meta)
+              if args.worker != 'control' else None)
+    try:
+        result = trainer.train_guarded(
+            args.steps, mgr, guard=guard, injector=inj,
+            metric_logger=logger, restart=restart, step_hook=progress)
+    except TrainingFailed as e:
+        print(f'TRAINING FAILED (structured): {e.to_record()}')
+        return 1
+    finally:
+        if logger is not None:
+            logger.close()
+        mgr.close(raise_on_timeout=False)
+    if not result.preempted:
+        _dump_params(trainer, params_path)
+    print(f'{args.worker} arm: steps={result.steps} '
+          f'preempted={result.preempted} diverged={result.diverged} '
+          f'counters={result.counters}')
+    return result.exit_code
+
+
+# --------------------------------------------------------------------- #
+# the orchestrator
+# --------------------------------------------------------------------- #
+def _spawn(args, worker, progress_file=None):
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--worker', worker, '--workdir', args.workdir,
+           '--steps', str(args.steps), '--window', str(args.window),
+           '--nodes', str(args.nodes), '--accum', str(args.accum),
+           '--seed', str(args.seed), '--nan-at', str(args.nan_at),
+           '--resume-nan-at', str(args.resume_nan_at),
+           '--weaken', args.weaken]
+    cmd.append('--pipelined' if args.pipelined else '--no-pipelined')
+    if args.metrics and worker != 'control':
+        cmd += ['--metrics', args.metrics]
+    if progress_file:
+        cmd += ['--progress-file', progress_file]
+    return subprocess.Popen(cmd)
+
+
+def _read_progress(path):
+    try:
+        with open(path) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def _load_leaves(path):
+    import numpy as np
+    with np.load(path) as z:
+        return [z[k] for k in z.files]
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.worker:
+        assert args.workdir, '--worker requires --workdir'
+        return worker_main(args)
+
+    if args.workdir is None:
+        args.workdir = tempfile.mkdtemp(prefix='train_chaos_')
+        atexit.register(shutil.rmtree, args.workdir, ignore_errors=True)
+    os.makedirs(args.workdir, exist_ok=True)
+    kill_after = (args.kill_after_step if args.kill_after_step is not None
+                  else args.steps // 2)
+    ok = True
+
+    if args.weaken == 'norollback':
+        # THE WEAKENED ARM: detection without response. One process, no
+        # kill — the NaN window trips, nothing restores, and the
+        # diverged gate must exit this script with rc 1.
+        print('WEAKENED GATE ARM: rollback is nulled (this run must '
+              'exit 1)')
+        p = _spawn(args, 'chaos')
+        rc = p.wait()
+        print(f'weakened arm rc={rc} (1 = the diverged gate FIRED; '
+              f'anything else means the gate is decoration)')
+        return rc
+
+    # ---- arm 1: control (the parity oracle) -------------------------- #
+    t0 = time.perf_counter()
+    rc = _spawn(args, 'control').wait()
+    if rc != 0:
+        print(f'FAIL: control arm exited {rc}')
+        return 2
+    print(f'control arm done in {time.perf_counter() - t0:.1f}s')
+
+    # the control arm checkpoints too — the chaos arm must start from
+    # scratch, so reset the checkpoint dir between arms
+    shutil.rmtree(os.path.join(args.workdir, 'ckpt'), ignore_errors=True)
+
+    # ---- arm 2: chaos + a real SIGTERM ------------------------------- #
+    progress_file = os.path.join(args.workdir, 'progress')
+    p = _spawn(args, 'chaos', progress_file=progress_file)
+    deadline = time.time() + 300
+    while time.time() < deadline and p.poll() is None:
+        if _read_progress(progress_file) >= kill_after:
+            break
+        time.sleep(0.05)
+    if p.poll() is not None:
+        print(f'FAIL: chaos arm exited early (rc={p.returncode}) — '
+              f'never reached the kill step {kill_after}')
+        return 2
+    print(f'SIGTERM -> chaos arm at step '
+          f'>= {_read_progress(progress_file)}')
+    p.send_signal(signal.SIGTERM)
+    rc = p.wait(timeout=120)
+    if rc != RESUMABLE_RC:
+        print(f'FAIL: chaos arm exited rc={rc} after SIGTERM — expected '
+              f'the RESUMABLE rc {RESUMABLE_RC}')
+        ok = False
+
+    # ---- arm 3: resume to completion --------------------------------- #
+    rc = _spawn(args, 'resume').wait()
+    if rc != 0:
+        print(f'FAIL: resume arm exited {rc}')
+        ok = False
+
+    # ---- gates ------------------------------------------------------- #
+    report = dict(ok=False, weaken=args.weaken, steps=args.steps,
+                  kill_after_step=kill_after, chaos_rc=RESUMABLE_RC)
+    control = os.path.join(args.workdir, 'params_control.npz')
+    resumed = os.path.join(args.workdir, 'params_resume.npz')
+    max_abs = None
+    if not (os.path.exists(control) and os.path.exists(resumed)):
+        print('FAIL: an arm produced no final params dump')
+        ok = False
+    else:
+        import numpy as np
+        a, b = _load_leaves(control), _load_leaves(resumed)
+        if len(a) != len(b):
+            print(f'FAIL: param tree sizes differ ({len(a)} vs {len(b)})')
+            ok = False
+        else:
+            max_abs = max(float(np.max(np.abs(x - y))) if x.size else 0.0
+                          for x, y in zip(a, b))
+            if max_abs != 0.0:
+                print(f'FAIL: resumed params differ from control '
+                      f'(max abs {max_abs:.3e}) — the kill-and-resume '
+                      f'trajectory is NOT the unfaulted one')
+                ok = False
+            else:
+                print(f'parity ok: {len(a)} param leaves bit-exact vs '
+                      f'the uninterrupted control arm')
+    report['final_param_max_abs_diff'] = max_abs
+
+    guard_rec = summary_rec = None
+    if args.metrics and os.path.exists(args.metrics):
+        from se3_transformer_tpu.observability.schema import (
+            SchemaError, validate_stream,
+        )
+        try:
+            info = validate_stream(args.metrics)
+            print(f'schema ok: {info["records"]} records {info["kinds"]}')
+        except SchemaError as e:
+            print(f'FAIL: telemetry stream invalid: {e}')
+            ok = False
+        recs = [json.loads(l) for l in open(args.metrics) if l.strip()]
+        guards = [r for r in recs if r.get('kind') == 'guard']
+        guard_rec = guards[-1] if guards else None
+        run_ids = [r['run_id'] for r in recs if r.get('kind') == 'run_meta']
+        resume_id = run_ids[-1] if run_ids else None
+        summaries = [r for r in recs if r.get('kind') == 'summary'
+                     and r.get('run_id') == resume_id]
+        summary_rec = summaries[-1] if summaries else None
+    if guard_rec is None:
+        print('FAIL: no guard record banked')
+        ok = False
+    else:
+        if guard_rec.get('rollbacks', 0) < 1:
+            print(f'FAIL: {guard_rec.get("rollbacks")} rollbacks — the '
+                  f'NaN trip was never OBSERVED paying down')
+            ok = False
+        if not guard_rec.get('injections_total'):
+            print('FAIL: zero injections in the final guard record')
+            ok = False
+        if guard_rec.get('diverged') is not False:
+            print(f'FAIL: diverged={guard_rec.get("diverged")!r}')
+            ok = False
+        if guard_rec.get('restarts', 0) < 1 or \
+                guard_rec.get('preemptions', 0) < 1:
+            print(f'FAIL: restarts={guard_rec.get("restarts")} / '
+                  f'preemptions={guard_rec.get("preemptions")} — the '
+                  f'kill never registered in the cumulative counters')
+            ok = False
+    if summary_rec is None:
+        print('FAIL: the resumed run banked no summary record')
+        ok = False
+    elif summary_rec.get('retrace_warnings_total', 0) != 0:
+        print(f'FAIL: {summary_rec["retrace_warnings_total"]} '
+              f'post-warmup retraces in the resumed run — restore must '
+              f'not change compiled shapes')
+        ok = False
+
+    report.update(ok=ok, guard=guard_rec,
+                  resume_retrace_warnings=(summary_rec or {}).get(
+                      'retrace_warnings_total'))
+    print(json.dumps(report, indent=2, default=str))
+    if args.out:
+        with open(args.out, 'w') as f:
+            json.dump(report, f, indent=2, default=str)
+        print(f'report -> {args.out}')
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -14,7 +14,17 @@ checkpoint, on demand, reproducibly. `FaultInjector` is that something
     fault_injector=...)` fires before/after the durable write (ctx:
     step, and path on the post-write site, where a `corrupt` plan
     tears the just-written checkpoint — the preemption-mid-write
-    scenario `restore`'s integrity fallback exists for).
+    scenario `restore`'s integrity fallback exists for);
+  * training sites (training.guardian / training.pipeline):
+    `step_dispatch` fires before every guarded optimizer step (ctx:
+    step — exception plans walk the rollback path a real device fault
+    walks), `step_batch` fires at batch build (a `nan` plan poisons
+    that step's coords, driving a genuine non-finite loss through the
+    jitted step), `batch_source` fires before every producer-thread
+    pull (`BatchProducer(fault_injector=...)` — exception plans
+    exercise the transient-retry/poison-skip path), and
+    `emergency_save` fires on the preemption handler's save path (a
+    dying emergency writer must still exit resumable).
 
 Fault kinds:
 
@@ -23,7 +33,16 @@ Fault kinds:
     retry-with-redispatch, health accounting, async-write barriers);
   * `latency`   — sleep `latency_s` (a slow replica / slow writer);
   * `corrupt`   — truncate the file (or every file under the dir) named
-    by ctx['path'] to `frac` of its bytes: a torn checkpoint on disk.
+    by ctx['path'] to `frac` of its bytes: a torn checkpoint on disk;
+  * `nan`       — COOPERATIVE: record the firing and return 'nan' from
+    `fire()`; the call site poisons its own data (the training
+    guardian multiplies the step's batch coords by NaN, so a genuine
+    non-finite loss walks the real jitted step — the injector cannot
+    reach into a compiled program, so the site cooperates).
+
+`fire()` returns the kind that acted ('exception' never returns — it
+raises) or None when no plan triggered; only cooperative kinds need
+the caller to look at it.
 
 Plans are DETERMINISTIC: each plan keeps its own call counter over the
 fires that match its site + ctx filters and triggers on explicit call
@@ -48,7 +67,7 @@ from typing import Callable, List, Optional, Sequence
 
 __all__ = ['FaultInjector', 'InjectedFault']
 
-FAULT_KINDS = ('exception', 'latency', 'corrupt')
+FAULT_KINDS = ('exception', 'latency', 'corrupt', 'nan')
 
 
 class InjectedFault(RuntimeError):
@@ -147,8 +166,11 @@ class FaultInjector:
     def fire(self, site: str, **ctx):
         """Instrumentation hook: evaluate every plan for `site` whose
         ctx filters match; act on the first that triggers (raise /
-        sleep / corrupt). Recording happens BEFORE the action, so an
-        injected exception is in the log even though it unwinds."""
+        sleep / corrupt / return 'nan'). Recording happens BEFORE the
+        action, so an injected exception is in the log even though it
+        unwinds. Returns the kind that acted (None when no plan
+        triggered) — cooperative kinds ('nan') rely on the caller
+        reading it."""
         for plan in self._plans:
             if plan.site != site:
                 continue
@@ -168,6 +190,8 @@ class FaultInjector:
                 path = ctx.get('path')
                 assert path, f'corrupt plan at {site} needs ctx path='
                 event['torn'] = corrupt_path(path, plan.frac)
+            elif plan.kind == 'nan':
+                pass     # cooperative: the caller poisons on 'nan'
             else:
                 raise InjectedFault(
                     site, f'{plan.kind} (call {plan.calls})', **ctx)
@@ -176,7 +200,7 @@ class FaultInjector:
             # on a future call — without this, stacked latency plans
             # would sleep twice and a latency+exception pair would do
             # both on one call, violating the documented contract
-            return
+            return plan.kind
 
     # ------------------------------------------------------------------ #
     @property

@@ -81,6 +81,19 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    and equivariance_l2_fused (the streaming kernel must
                    still be equivariant). `make flash-smoke` gates on
                    it and PERF_BUDGETS.json enforces both wins.
+  guard            training-side fault-domain evidence for one guarded
+                   run (training.guardian, exercised by
+                   scripts/train_chaos_smoke.py): the counter set
+                   {trips, rollbacks, restarts, skipped_batches,
+                   preemptions, injections_total} — CUMULATIVE across
+                   process restarts (the guardian's sidecar carries
+                   them over a kill, so the record a resumed run banks
+                   tells the whole run's story) — plus the
+                   load-bearing `diverged` bit: final params
+                   non-finite, or a trip the rollback policy never
+                   paid down. MUST be false; `make train-chaos-smoke`
+                   and obs_report --require guard gate on it, and a
+                   guard record with zero injections proves nothing.
   fault            fault-domain evidence for one chaos/serving run
                    (serving.RouterTelemetry.fault_flush, exercised by
                    scripts/chaos_smoke.py): injections (the seeded
@@ -137,7 +150,7 @@ SCHEMA_VERSION = 1
 
 KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
                'serve', 'tune', 'comm', 'cost', 'profile', 'so2_sweep',
-               'flash', 'fault', 'quant_ab', 'summary')
+               'flash', 'fault', 'guard', 'quant_ab', 'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
@@ -178,6 +191,14 @@ _REQUIRED = {
     'fault': ('run_id', 'label', 'injections', 'injections_total',
               'health_transitions', 'recoveries', 'retries',
               'request_failures', 'timeouts', 'lost_requests'),
+    # diverged is the load-bearing field of the training fault-domain
+    # contract: a guard record that cannot say whether the run ended on
+    # finite, policy-clean parameters proves nothing about
+    # self-healing (and injections_total=0 proves nothing was
+    # exercised). Counters are cumulative across process restarts.
+    'guard': ('run_id', 'step', 'trips', 'rollbacks', 'restarts',
+              'skipped_batches', 'preemptions', 'injections_total',
+              'diverged'),
     # the memory ratio + the parity/equivariance figures are the
     # load-bearing quartet of the quantized-serving contract: a record
     # that cannot say the mix is smaller, implementation-faithful, AND
@@ -208,6 +229,8 @@ _PIPELINE_VERDICTS = ('producer_bound', 'device_bound', 'balanced')
 _HEALTH_STATES = ('healthy', 'degraded', 'quarantined')
 _FAULT_COUNTERS = ('injections_total', 'recoveries', 'retries',
                    'request_failures', 'timeouts', 'lost_requests')
+_GUARD_COUNTERS = ('trips', 'rollbacks', 'restarts', 'skipped_batches',
+                   'preemptions', 'injections_total')
 
 _COST_SOURCES = ('cost_analysis', 'hlo_estimate', 'unavailable')
 _COST_MEMORY_REQUIRED = ('argument_bytes', 'output_bytes', 'temp_bytes')
@@ -258,6 +281,18 @@ def validate_record(rec: dict, index=None) -> dict:
         if rec['verdict'] not in _PIPELINE_VERDICTS:
             _fail(index, f'pipeline.verdict {rec["verdict"]!r} not in '
                          f'{_PIPELINE_VERDICTS}')
+        # source fault counters (BatchProducer retry/skip) are optional
+        # but validated when present — the train-chaos gate reads them
+        if 'source' in rec:
+            src = rec['source']
+            if not isinstance(src, dict):
+                _fail(index, 'pipeline.source must be an object')
+            for field in ('retries', 'skipped'):
+                val = src.get(field)
+                if not isinstance(val, int) or isinstance(val, bool) \
+                        or val < 0:
+                    _fail(index, f'pipeline.source.{field} must be a '
+                                 f'non-negative int, got {val!r}')
     if kind == 'serve':
         requests = rec['requests']
         if not isinstance(requests, dict) or 'served' not in requests \
@@ -338,6 +373,16 @@ def validate_record(rec: dict, index=None) -> dict:
                     or 'to_state' not in e:
                 _fail(index, f'fault.health_transitions entries must '
                              f'carry from_state/to_state, got {e!r}')
+    if kind == 'guard':
+        for field in _GUARD_COUNTERS + ('step',):
+            val = rec[field]
+            if not isinstance(val, int) or isinstance(val, bool) \
+                    or val < 0:
+                _fail(index, f'guard.{field} must be a non-negative '
+                             f'int, got {val!r}')
+        if not isinstance(rec['diverged'], bool):
+            _fail(index, f'guard.diverged must be a bool, got '
+                         f'{rec["diverged"]!r}')
     if kind == 'tune':
         if rec['verdict'] not in _TUNE_VERDICTS:
             _fail(index, f'tune.verdict {rec["verdict"]!r} not in '

@@ -79,21 +79,40 @@ def tp_rules(axis: str = 'tp') -> Rules:
     """Tensor parallelism over `axis` — the rule-set form of the old
     ad-hoc `param_partition_specs` body. Rank guards reproduce its
     exact ndim checks: a name-match with the wrong rank falls through
-    to the catch-all replication rule."""
+    to the catch-all replication rule.
+
+    Quantized trees (quant.QuantTensor pytree nodes) descend one level
+    deeper: the weight's `q` storage and `scale` leaves surface as
+    '<weight>/q' and '<weight>/scale' paths. `q` keeps the fp32
+    weight's shape and shards exactly like it; `scale` keeps the
+    contracted axis as size 1 (per-output-channel layout), so it
+    shards with the OUTPUT axis wherever the weight's output axis is
+    sharded (column-parallel) and replicates under row-parallel specs
+    (the per-output epilogue runs after the psum on the full output
+    axis). Before these rules, quantized params silently fell through
+    to the catch-all and REPLICATED under tp-sharded serving — the
+    ROADMAP item 3 residue."""
     col = '|'.join(_COLUMN_PARALLEL)
     row = '|'.join(_ROW_PARALLEL)
     return (
         # radial final weight [mid, c_in*F, c_out] — both the per-pair
         # 'w3'/'b3' (PairwiseConvSE3) and the shared-trunk group layout
-        # 'w3_{d_in}_{d_out}' (ConvSE3): shard the OUTPUT channel axis
-        (r'(^|/)w3(_\d+_\d+)?$', P(None, None, axis), 3),
+        # 'w3_{d_in}_{d_out}' (ConvSE3): shard the OUTPUT channel axis.
+        # Quantized: q [mid, IF, O] int8 + scale [1, IF, O] both carry
+        # the same rank and a divisible output axis
+        (r'(^|/)w3(_\d+_\d+)?(/(?:q|scale))?$', P(None, None, axis), 3),
         (r'(^|/)b3(_\d+_\d+)?$', P(None, axis), 2),
         # attention/FF in-projections: column-shard the output axis
-        # (= heads * dim_head, i.e. head sharding)
-        (rf'(^|/)(?:{col})/w\d+$', P(None, axis), 2),
+        # (= heads * dim_head, i.e. head sharding); scale [1, out]
+        # shards its output axis right along
+        (rf'(^|/)(?:{col})/w\d+(/(?:q|scale))?$', P(None, axis), 2),
         # out-projections: row-shard the INPUT axis — the classic
-        # column->row pair with one psum per block
-        (rf'(^|/)(?:{row})/w\d+$', P(axis, None), 2),
+        # column->row pair with one psum per block. The quantized q
+        # row-shards like the weight; the per-OUTPUT scale replicates
+        # (its epilogue multiplies the full post-psum output, and its
+        # size-1 input dim would only demote noisily)
+        (rf'(^|/)(?:{row})/w\d+(/q)?$', P(axis, None), 2),
+        (rf'(^|/)(?:{row})/w\d+/scale$', P(), 2),
         # everything else (norms, embeddings, gates) is tiny: replicate
         (r'.*', P()),
     )
@@ -103,8 +122,17 @@ def fsdp_rules(axis: str = 'dp') -> Rules:
     """Fully-sharded parameters: every non-scalar leaf shards dim 0
     over `axis` (indivisible dims demote to replication under the mesh
     audit). Applied to optimizer state too, this is true FSDP — the
-    ROADMAP item 5 extension rides the same rule set."""
-    return ((r'.*', P(axis)),)
+    ROADMAP item 5 extension rides the same rule set. Quantized
+    `scale` leaves (size-1 contracted dim 0, a few KB) replicate
+    explicitly instead of demoting with a warning on every placement;
+    the int8 `q` storage falls through to the catch-all and shards
+    dim 0 like the fp32 weight it replaced. The scale rule is anchored
+    to the quantizable weight names (w<d> / w3_i_o / Dense kernel) so
+    flax's LayerNorm `scale` param keeps its plain dim-0 treatment."""
+    return (
+        (r'(^|/)(?:w\d+(?:_\d+_\d+)?|kernel)/scale$', P()),
+        (r'.*', P(axis)),
+    )
 
 
 RULE_SETS = dict(replicated=replicated_rules, tp=tp_rules,

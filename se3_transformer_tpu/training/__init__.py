@@ -3,6 +3,10 @@ from .denoise import (
     synthetic_protein_batch_host, chain_adjacency,
 )
 from .checkpoint import CheckpointManager, snapshot_device_arrays
+from .guardian import (
+    GuardConfig, PreemptionGuard, RESUMABLE_RC, SpikeDetector, StepGuard,
+    TrainingFailed, resume_trainer, run_guarded,
+)
 from .dataset import PointCloudDataset, save_point_cloud_dataset
 from .pipeline import (
     BatchProducer, BatchProducerError, PipelineStats, dataset_batch_source,

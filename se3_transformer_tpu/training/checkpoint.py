@@ -19,6 +19,12 @@ answered. The deterministic `faults.FaultInjector` can tear a
 just-written checkpoint on demand (`fault_injector=` +
 `checkpoint_written` corrupt plans), which is how `make chaos-smoke`
 and the kill-and-resume test prove this path, not just ship it.
+
+Retention is torn-step-aware: keep-last-k GC never deletes the newest
+step that actually RESTORES (`verify_step` probes integrity — orbax
+metadata read / pickle deserialize, cached once proven), so a run
+whose recent writes are all torn keeps its rollback target alive
+beyond `max_to_keep` instead of GC-ing itself unrecoverable.
 """
 from __future__ import annotations
 
@@ -110,6 +116,10 @@ class CheckpointManager:
         # keeps waiting (slow != wedged), close paths warn AND raise
         self.writer_timeout_s = float(writer_timeout_s)
         self.last_restored_step: Optional[int] = None
+        # steps PROVEN restorable (verify_step / a successful restore):
+        # the torn-aware GC consults this before deleting anything that
+        # might be the only restorable rollback target left
+        self._verified: set = set()
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f'step_{step:08d}')
@@ -136,6 +146,11 @@ class CheckpointManager:
         paths): orbax writes to a tmp dir and renames at finalize; the
         pickle fallback writes .pkl.tmp and os.replace()s it — either
         way `latest_step` only ever sees completed checkpoints."""
+        # rewriting a step voids any earlier integrity proof: if THIS
+        # write tears (preemption mid-write, a corrupt plan), a stale
+        # cache entry would let the torn-aware GC protect the torn
+        # rewrite while deleting the genuinely restorable target
+        self._verified.discard(int(step))
         if self.fault_injector is not None:
             self.fault_injector.fire('checkpoint_write', step=int(step))
         if self._ckptr is not None:
@@ -266,6 +281,7 @@ class CheckpointManager:
                     f'the next-newest step', RuntimeWarning)
                 continue
             self.last_restored_step = step
+            self._verified.add(step)   # a full restore IS the proof
             if errors:
                 print(f'checkpoint: restored step {step} after '
                       f'{len(errors)} corrupt newer step(s): '
@@ -287,6 +303,7 @@ class CheckpointManager:
         if step is not None:
             state = self._restore_step(step, like)
             self.last_restored_step = int(step)
+            self._verified.add(int(step))
             return state
         return self._fallback_restore(
             lambda s: self._restore_step(s, like), 'restore')
@@ -372,12 +389,62 @@ class CheckpointManager:
             state = pickle.load(f)
         return self._params_subtree(state)[1]
 
+    # ------------------------------------------------------------------ #
+    # torn-step-aware retention: keep-last-k, but NEVER delete the
+    # newest step that actually restores (the rollback target)
+    # ------------------------------------------------------------------ #
+    def verify_step(self, step: int) -> bool:
+        """Integrity probe: does this step load? Orbax entries verify
+        via a metadata read (cheap — no array data); the pickle
+        fallback must deserialize the blob (full read — acceptable at
+        this repo's scales, and the result is cached per step so the
+        common every-save GC re-verifies only the newest entry).
+        A successful probe is cached in `_verified`."""
+        if step in self._verified:
+            return True
+        try:
+            path = self._step_dir(step)
+            if self._ckptr is not None and os.path.isdir(path):
+                self._ckptr.metadata(path)
+            else:
+                with open(path + '.pkl', 'rb') as f:
+                    pickle.load(f)
+        except Exception:  # noqa: BLE001 - torn entries fail any way
+            return False
+        self._verified.add(step)
+        return True
+
+    def _newest_restorable(self, steps) -> Optional[int]:
+        for step in reversed(steps):
+            if self.verify_step(step):
+                return step
+        return None
+
     def _gc(self):
+        """keep-last-k retention with the rollback target protected:
+        a run whose newest writes are all torn (preemptions mid-write,
+        the injector's corrupt plans) must never GC away the one step
+        `restore()`'s fallback would land on — deleting it would turn
+        the NEXT trip into an unrecoverable 'no restorable checkpoint'.
+        The newest step that verifies survives GC even when it falls
+        outside the keep window."""
         steps = self.all_steps()
-        for step in steps[:-self.max_to_keep]:
+        doomed = steps[:-self.max_to_keep]
+        if not doomed:
+            return
+        target = self._newest_restorable(steps)
+        for step in doomed:
+            if target is not None and step == target:
+                warnings.warn(
+                    f'checkpoint GC kept step {step} beyond '
+                    f'max_to_keep={self.max_to_keep}: every newer step '
+                    f'is torn and this is the newest restorable '
+                    f'rollback target', RuntimeWarning)
+                continue
             path = self._step_dir(step)
             if os.path.isdir(path):
                 import shutil
                 shutil.rmtree(path, ignore_errors=True)
             elif os.path.exists(path + '.pkl'):
                 os.remove(path + '.pkl')
+            self._verified.discard(step)

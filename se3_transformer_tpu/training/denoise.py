@@ -644,6 +644,7 @@ class DenoiseTrainer:
         micro = max(1, cfg.accum_steps)
         with BatchProducer(batch_source,
                            capacity=cfg.producer_capacity) as producer:
+            stats.bind_source(producer)
             batches = device_prefetch(
                 producer, depth=cfg.prefetch_depth, sharding=place,
                 phase_timer=self.phase_timer, stats=stats)
@@ -687,3 +688,28 @@ class DenoiseTrainer:
             history.append(self.telemetry_close(metric_logger))
             history.append(self._pipeline_record(stats, metric_logger))
         return history
+
+    # ------------------------------------------------------------------ #
+    # self-healing elastic loop (training.guardian): NaN/spike rollback,
+    # preemption-safe emergency save, deterministic per-step replay
+    # ------------------------------------------------------------------ #
+    def train_guarded(self, num_steps: int, checkpoint_manager,
+                      guard=None, injector=None, metric_logger=None,
+                      restart: bool = False, step_hook=None, log=print):
+        """`train` with the training fault domain wrapped around it
+        (docs/ROBUSTNESS.md "Training fault domain"): window-level
+        non-finite/spike detection off the telemetry accumulator (no
+        extra host sync on clean steps), bounded rollback to the newest
+        restorable checkpoint, SIGTERM/SIGINT -> one synchronous
+        emergency save + a resumable exit, and a schema'd `guard`
+        record. Requires cfg.telemetry; honors cfg.pipeline. Batches
+        and step rngs derive from the ABSOLUTE step index, so a
+        rolled-back or resumed run replays bit-exactly — `make
+        train-chaos-smoke` gates final-param parity on it. Returns a
+        `guardian.GuardResult` (`.exit_code`: 0 clean, 1 diverged,
+        75 preempted-resumable)."""
+        from .guardian import run_guarded
+        return run_guarded(self, num_steps, checkpoint_manager,
+                           guard=guard, injector=injector,
+                           metric_logger=metric_logger, restart=restart,
+                           step_hook=step_hook, log=log)

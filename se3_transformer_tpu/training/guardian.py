@@ -1,0 +1,726 @@
+"""Self-healing training: NaN/spike rollback, preemption safety, and
+the guarded elastic loop (the training-side fault domain).
+
+PR 12 gave SERVING a fault domain (health breakers, structured retry,
+torn-checkpoint fallback); this module gives the TRAINING loop the same
+gated, injectable treatment. A pod-scale run on preemptible slices dies
+three ways the plain loop cannot survive:
+
+  * a non-finite loss/grad (one bad batch, an overflowing activation)
+    poisons the params within one `apply_updates` and every later step
+    trains a corpse;
+  * a SIGTERM lands mid-run and the work since the last periodic
+    checkpoint is gone — or worse, a checkpoint is torn mid-write;
+  * a wedged or flaky batch source kills the run outright
+    (`BatchProducerError`).
+
+The pieces, composed by `run_guarded`:
+
+  * `StepGuard` — detection WITHOUT new host syncs: the guarded loop
+    requires `cfg.telemetry`, so loss and global grad norm already fold
+    into the on-device `MetricAccumulator`; the guard inspects the
+    per-window stats the existing `telemetry_flush` fetches (one
+    device-to-host transfer per window, same as before). Non-finite
+    window stats trip immediately; an EMA z-score detector
+    (`SpikeDetector`) trips on a loss-mean spike after a warmup.
+  * rollback policy — on a trip, restore the newest restorable
+    checkpoint via `CheckpointManager.restore` (PR 12's fallback-aware
+    path: a torn latest step is skipped loudly), re-place it with
+    `DenoiseTrainer.restore` (fsdp/tp shards land back in place), and
+    replay. Replay is DETERMINISTIC: every batch and step rng derives
+    from the absolute step index (`fold_in`, per-step RandomState), so
+    a rolled-back run converges on the exact trajectory of a run that
+    never faulted — the train-chaos smoke gates bit-exact final-param
+    parity on it. Rollbacks count against `restart_budget`; exceeding
+    it raises a structured `TrainingFailed` (counters attached), never
+    an unbounded crash loop.
+  * `PreemptionGuard` — SIGTERM/SIGINT set a flag the loop reads
+    between steps; the loop then barriers the async checkpoint writer,
+    performs ONE synchronous emergency save (the `emergency_save`
+    fault site lets the chaos harness kill even that — the run still
+    exits resumable and falls back to the last periodic checkpoint),
+    and the CLI exits with `RESUMABLE_RC` (75, EX_TEMPFAIL) so a
+    supervisor restarts it instead of declaring failure.
+  * the `guard` JSONL record — one per guarded run (schema'd in
+    observability.schema): trips / rollbacks / restarts /
+    skipped_batches / preemptions / injections_total and the
+    load-bearing `diverged` bit (final params non-finite, or a trip
+    the policy never paid down). Counters persist across process
+    restarts through a JSON sidecar next to the checkpoints
+    (`guard_state.json`), so the record a resumed run banks tells the
+    WHOLE run's story — `obs_report --require guard` and the
+    train-chaos perf budgets gate on it.
+
+`make train-chaos-smoke` is the acceptance pair: a run with an
+injected-NaN step and a mid-run SIGTERM must resume and finish with
+final params bit-exact vs an uninterrupted control arm, and a
+`--weaken` arm that nulls the rollback must exit rc==1 (the diverged
+gate fires rather than decorates).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import signal
+import warnings
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+__all__ = [
+    'GuardConfig', 'PreemptionGuard', 'RESUMABLE_RC', 'SpikeDetector',
+    'StepGuard', 'TrainingFailed', 'poison_batch', 'resume_trainer',
+    'run_guarded',
+]
+
+# EX_TEMPFAIL: the documented "preempted, resume me" exit code — a
+# supervisor distinguishes it from rc 1 (failed loud) and restarts
+RESUMABLE_RC = 75
+
+_GUARD_STATE_FILE = 'guard_state.json'
+_COUNTERS = ('trips', 'rollbacks', 'restarts', 'skipped_batches',
+             'preemptions', 'injections_total')
+
+
+class TrainingFailed(RuntimeError):
+    """The guard's restart budget is spent (or the policy cannot act):
+    training fails LOUD with its counters attached — a supervisor must
+    treat this as terminal, not preemption."""
+
+    def __init__(self, message: str, **counters):
+        super().__init__(message)
+        self.counters = dict(counters)
+
+    def to_record(self) -> dict:
+        return dict(error='training_failed', message=str(self),
+                    **self.counters)
+
+
+@dataclasses.dataclass
+class GuardConfig:
+    """Knobs of the self-healing policy (README "Self-healing
+    training" table)."""
+    # EMA z-score loss-spike detection: trip when the window's loss
+    # mean sits more than `spike_zscore` EMA standard deviations above
+    # the EMA mean, after `warmup_windows` clean windows armed the
+    # statistics (early-training loss falls fast — arming immediately
+    # would trip on the descent)
+    spike_zscore: float = 8.0
+    ema_decay: float = 0.9
+    warmup_windows: int = 3
+    # rollback policy: restore + replay at most `restart_budget` times
+    # before failing loud; `rollback=False` is the WEAKENED arm of the
+    # train-chaos gate (detection without response — the run must then
+    # end diverged and exit rc 1)
+    restart_budget: int = 3
+    rollback: bool = True
+    # skip the offending batch window instead of replaying it (for
+    # genuinely poisonous data that would re-trip deterministically;
+    # OFF by default — replay preserves bit-exact parity with an
+    # unfaulted run because injected faults do not re-fire)
+    skip_window: bool = False
+    # guarded-pipeline BatchProducer hardening (training.pipeline):
+    # transient source errors retry with bounded backoff, then up to
+    # `source_max_skips` poison batches are dropped (counted in the
+    # pipeline record's `source` section) before failing structured
+    source_max_retries: int = 2
+    source_retry_backoff_s: float = 0.05
+    source_max_skips: int = 0
+
+
+class SpikeDetector:
+    """EMA mean/variance z-score over flushed window loss means.
+
+    `observe(x)` returns True when x spikes beyond `zscore` EMA
+    standard deviations; clean observations update the statistics,
+    spiking ones do NOT (a spike must not drag the baseline up and
+    mask its successors)."""
+
+    def __init__(self, zscore: float = 8.0, decay: float = 0.9,
+                 warmup: int = 3):
+        self.zscore = float(zscore)
+        self.decay = float(decay)
+        self.warmup = int(warmup)
+        self.mean: Optional[float] = None
+        self.var = 0.0
+        self.seen = 0
+
+    def observe(self, x: float) -> bool:
+        if not math.isfinite(x):
+            return True
+        if self.mean is not None and self.seen >= self.warmup:
+            sd = math.sqrt(max(self.var, 1e-12))
+            if (x - self.mean) / sd > self.zscore:
+                return True
+        if self.mean is None:
+            self.mean = x
+        else:
+            d = x - self.mean
+            self.mean += (1.0 - self.decay) * d
+            self.var = self.decay * (self.var + (1.0 - self.decay) * d * d)
+        self.seen += 1
+        return False
+
+
+class StepGuard:
+    """Window-level fault detection + the guard record's counters.
+
+    Reads ONLY the stats `telemetry_flush` already fetched — no
+    additional host sync on clean steps. Counters may be seeded from a
+    previous process's sidecar (`load_counters`) so a resumed run's
+    final record is cumulative."""
+
+    def __init__(self, cfg: Optional[GuardConfig] = None):
+        self.cfg = cfg or GuardConfig()
+        self.spikes = SpikeDetector(self.cfg.spike_zscore,
+                                    self.cfg.ema_decay,
+                                    self.cfg.warmup_windows)
+        self.counters = {k: 0 for k in _COUNTERS}
+        self.diverged = False
+        self.last_verdict = 'ok'
+
+    # -- detection ------------------------------------------------------ #
+    def check_window(self, window: dict) -> str:
+        """'ok' | 'nonfinite' | 'spike' for one flushed metric window
+        ({'loss': {count, mean, min, max}, 'grad_norm': {...}})."""
+        vals = []
+        for name in ('loss', 'grad_norm'):
+            st = window.get(name) or {}
+            vals += [st.get(k) for k in ('mean', 'min', 'max')
+                     if st.get(k) is not None]
+        if any(not math.isfinite(v) for v in vals):
+            self.last_verdict = 'nonfinite'
+            return 'nonfinite'
+        loss = (window.get('loss') or {}).get('mean')
+        if loss is not None and self.spikes.observe(loss):
+            self.last_verdict = 'spike'
+            return 'spike'
+        self.last_verdict = 'ok'
+        return 'ok'
+
+    # -- counters / persistence ----------------------------------------- #
+    def bump(self, name: str, by: int = 1):
+        self.counters[name] += by
+
+    def load_counters(self, directory: str):
+        """Seed counters from a previous process's sidecar (resume)."""
+        path = os.path.join(directory, _GUARD_STATE_FILE)
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                saved = json.load(f)
+        except Exception as e:  # noqa: BLE001 - a torn sidecar must
+            # never block a resume; the counters restart from zero
+            warnings.warn(f'guard sidecar {path} unreadable '
+                          f'({type(e).__name__}: {e}) — counters reset',
+                          RuntimeWarning)
+            return
+        for k in _COUNTERS:
+            if isinstance(saved.get(k), int):
+                self.counters[k] = saved[k]
+
+    def save_counters(self, directory: str):
+        """Atomic sidecar write (same tmp+replace idiom as the pickle
+        checkpoint path)."""
+        path = os.path.join(directory, _GUARD_STATE_FILE)
+        tmp = path + '.tmp'
+        with open(tmp, 'w') as f:
+            json.dump(self.counters, f)
+        os.replace(tmp, path)
+
+    def record(self, step: int, injector=None) -> dict:
+        """The schema'd `guard` record fields. `injections_total` is
+        cumulative: the carried counter plus THIS process's injector
+        firings (bumped in as they happen by run_guarded)."""
+        fields = dict(step=int(step), diverged=bool(self.diverged),
+                      **{k: int(v) for k, v in self.counters.items()})
+        if injector is not None:
+            fields['injections_by_site'] = injector.snapshot()['by_site']
+        return fields
+
+
+class PreemptionGuard:
+    """SIGTERM/SIGINT -> a flag the step loop polls (signal-handler
+    context: set a bool, nothing else). Context-managed so the previous
+    handlers are restored on exit; `request_stop()` is the programmatic
+    equivalent for tests and the in-process kill-and-resume proofs."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self.stop_requested = False
+        self.signame: Optional[str] = None
+        self._previous = {}
+
+    def request_stop(self, signame: str = 'request_stop'):
+        self.stop_requested = True
+        self.signame = signame
+
+    def _handler(self, signum, frame):
+        self.request_stop(signal.Signals(signum).name)
+
+    def __enter__(self) -> 'PreemptionGuard':
+        for sig in self.SIGNALS:
+            try:
+                self._previous[sig] = signal.signal(sig, self._handler)
+            except ValueError:
+                # not the main thread (e.g. a test runner worker):
+                # programmatic request_stop still works
+                pass
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+        return False
+
+
+# --------------------------------------------------------------------- #
+# deterministic elastic derivations: everything a step consumes comes
+# from the ABSOLUTE step index, so a resume/rollback replays bit-exactly
+# --------------------------------------------------------------------- #
+def step_batch_rng(seed: int, step_index: int) -> np.random.RandomState:
+    """Per-step host rng: independent of run history, so step k's batch
+    is identical whether reached straight through, after a rollback, or
+    in a resumed process."""
+    return np.random.RandomState((int(seed) * 1000003 + step_index)
+                                 % (2 ** 31 - 1))
+
+
+def step_train_rng(seed: int, step_index: int):
+    """Per-step jax rng, same contract."""
+    return jax.random.fold_in(jax.random.PRNGKey(int(seed)),
+                              int(step_index))
+
+
+def poison_batch(batch: dict) -> dict:
+    """The cooperative half of the injector's `nan` kind: scale the
+    coords by NaN so a genuine non-finite loss flows through the real
+    jitted step (the injector cannot reach into a compiled program)."""
+    out = dict(batch)
+    out['coords'] = np.asarray(batch['coords']) * np.float32(np.nan)
+    return out
+
+
+def _host_micro_batches(trainer, step_index: int) -> dict:
+    """Deterministic replacement for trainer.micro_batches_host():
+    accum_steps micro-batches from the per-step rng, stacked on a
+    leading axis exactly like the stateful builder."""
+    from .denoise import synthetic_protein_batch_host
+    cfg = trainer.cfg
+    rng = step_batch_rng(cfg.seed, step_index)
+    batches = [synthetic_protein_batch_host(cfg, rng)
+               for _ in range(max(1, cfg.accum_steps))]
+    if cfg.accum_steps <= 1:
+        return batches[0]
+    return {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+
+
+def _tree_finite(tree) -> bool:
+    for leaf in jax.tree_util.tree_leaves(tree):
+        a = np.asarray(leaf)
+        if np.issubdtype(a.dtype, np.floating) and \
+                not np.isfinite(a).all():
+            return False
+    return True
+
+
+def _restore_state(trainer, checkpoint_manager):
+    """Fallback-aware restore, normalized into FRESH, UNCOMMITTED,
+    donation-safe device buffers. Both halves are load-bearing on
+    jax 0.4.37:
+
+    * uncommitted — orbax hands back arrays COMMITTED to their device;
+      the step's own outputs are uncommitted, and feeding committed
+      twins to the jitted step creates a SECOND lowering (a
+      post-warmup recompile, exactly what the chaos gate forbids).
+      A fresh host copy + plain `jnp.asarray` strips the commitment.
+    * fresh buffers — `np.asarray`/`jnp.asarray` on CPU are ZERO-COPY
+      views, so the donating step would free a buffer the restored
+      array still references (observed as heap corruption, not a
+      clean error). `np.array` forces the host copy and
+      `snapshot_device_arrays` (the same primitive that makes async
+      checkpoints donation-proof) lands them in buffers nothing else
+      holds."""
+    from .checkpoint import snapshot_device_arrays
+    import jax.numpy as jnp
+    state = checkpoint_manager.restore(
+        like=(trainer.params, trainer.opt_state, trainer.step_count))
+    state = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(np.array(x)) if hasattr(x, 'dtype') else x,
+        state)
+    return snapshot_device_arrays(state)
+
+
+def resume_trainer(trainer, checkpoint_manager) -> int:
+    """Adopt the newest restorable checkpoint into `trainer` (the
+    process-restart half of the elastic loop): init abstract state if
+    needed, restore with the fallback-aware path, re-place under the
+    trainer's sharding config. Returns the restored step (0 when the
+    directory holds no checkpoint — a fresh run)."""
+    if checkpoint_manager.latest_step() is None:
+        return 0
+    if trainer.params is None:
+        trainer.init()
+    trainer.restore(_restore_state(trainer, checkpoint_manager))
+    return trainer.step_count
+
+
+@dataclasses.dataclass
+class GuardResult:
+    """What run_guarded hands back (the CLI maps it to an exit code)."""
+    steps: int
+    preempted: bool
+    diverged: bool
+    counters: dict
+    history: list
+    guard_record: Optional[dict] = None
+
+    @property
+    def exit_code(self) -> int:
+        if self.preempted:
+            return RESUMABLE_RC
+        return 1 if self.diverged else 0
+
+
+def run_guarded(trainer, num_steps: int, checkpoint_manager,
+                guard: Optional[StepGuard] = None,
+                injector=None, metric_logger=None,
+                restart: bool = False,
+                step_hook: Optional[Callable[[int], None]] = None,
+                log=print) -> GuardResult:
+    """The self-healing elastic loop over `DenoiseTrainer`.
+
+    Requires `trainer.cfg.telemetry` (detection rides the existing
+    accumulator — zero extra host syncs on clean steps) and a
+    `CheckpointManager`. The window size is `cfg.flush_every`: each
+    window runs that many steps, flushes telemetry once, checks the
+    window, and — when clean — checkpoints asynchronously (the window
+    boundary IS the rollback grain; serialization overlaps the next
+    window, and every consumer barriers before reading —
+    rollback, the emergency save, the next save). `cfg.pipeline`
+    selects the
+    overlapped data path: a `BatchProducer` (wired to `injector`'s
+    `batch_source` site, retry/skip per its knobs) feeds
+    `device_prefetch` per SEGMENT — a rollback or preemption closes the
+    producer and the next segment restarts it at the rolled-back step.
+
+    `restart=True` marks a resumed process: counters load from the
+    sidecar and `restarts` bumps (the guard record stays cumulative
+    across the kill).
+
+    `step_hook(step_count)` runs after every optimizer step — the smoke
+    worker uses it to publish progress; tests use it to call
+    `PreemptionGuard.request_stop` at an exact step.
+    """
+    cfg = trainer.cfg
+    assert cfg.telemetry, (
+        'run_guarded requires DenoiseConfig(telemetry=True): non-finite '
+        'detection rides the on-device MetricAccumulator so clean steps '
+        'cost zero extra host syncs')
+    guard = guard or StepGuard()
+    gcfg = guard.cfg
+    window = max(1, cfg.flush_every)
+    guard.load_counters(checkpoint_manager.directory)
+    if restart:
+        guard.bump('restarts')
+    history = []
+    last_good_step = trainer.step_count
+    # a first-window trip must have something to roll back to: anchor
+    # whenever the DIRECTORY is empty, not just when the trainer is
+    # cold (a warm trainer pointed at a fresh checkpoint dir would
+    # otherwise crash the first rollback with 'no checkpoints')
+    needs_anchor = checkpoint_manager.latest_step() is None
+    # injections carried from a previous process (sidecar) + THIS
+    # process's injector total, synced from the injector's own count
+    # whenever the counters surface — the injector fires from both the
+    # step loop and the producer thread, so a read-fire-delta scheme
+    # would race; one atomic read of its total cannot
+    base_injections = guard.counters['injections_total']
+
+    def sync_injections():
+        if injector is not None:
+            guard.counters['injections_total'] = (
+                base_injections + injector.injections_total)
+
+    def fire(site, **ctx):
+        if injector is None:
+            return None
+        return injector.fire(site, **ctx)
+
+    def save_good(step, sync=False):
+        """Checkpoint a guard-clean state. Window saves go through
+        `save_async` (snapshot + writer thread) so serialization
+        overlaps the next window's steps — every consumer of the
+        checkpoint (rollback, emergency save, the manager's own next
+        save) barriers first, and a kill racing the writer merely
+        falls back one window of deterministic replay. The emergency
+        path passes sync=True: its whole point is durability BEFORE
+        the process exits."""
+        nonlocal last_good_step
+        state = (trainer.params, trainer.opt_state, step)
+        if sync:
+            checkpoint_manager.save(step, state)
+        else:
+            checkpoint_manager.save_async(step, state)
+        sync_injections()
+        guard.save_counters(checkpoint_manager.directory)
+        last_good_step = step
+
+    def emergency_save(step):
+        """One synchronous save on the preemption path: barrier any
+        async writer first, and survive the save itself dying (the
+        `emergency_save` fault site) — the restart then falls back to
+        the last periodic checkpoint. The partial window is flushed
+        and guard-checked FIRST: a preemption landing in the same
+        window as a NaN step must not checkpoint poisoned params as
+        the newest resume point (the restart would restore the
+        corpse and burn the whole budget re-restoring it)."""
+        guard.bump('preemptions')
+        try:
+            flush = trainer.telemetry_flush(metric_logger)
+            history.append(flush)
+            if guard.check_window(flush.get('window') or {}) != 'ok':
+                warnings.warn(
+                    f'preemption landed on a TRIPPED window at step '
+                    f'{step} — skipping the emergency save; restart '
+                    f'resumes from the last good step '
+                    f'{last_good_step}', RuntimeWarning)
+            else:
+                fire('emergency_save', step=int(step))
+                checkpoint_manager.wait_until_finished()
+                save_good(step, sync=True)
+                log(f'preemption: emergency checkpoint at step {step}, '
+                    f'exiting resumable (rc {RESUMABLE_RC})')
+        except Exception as e:  # noqa: BLE001 - the emergency writer
+            # dying must not turn a preemption into a hard failure
+            warnings.warn(
+                f'emergency checkpoint failed ({type(e).__name__}: {e}) '
+                f'— exiting resumable anyway; restart falls back to '
+                f'step {last_good_step}', RuntimeWarning)
+        sync_injections()
+        guard.save_counters(checkpoint_manager.directory)
+
+    def rollback(reason: str) -> bool:
+        """Restore the newest restorable checkpoint and rewind the
+        loop. Returns False when the policy cannot (weakened arm)."""
+        guard.bump('trips')
+        if not gcfg.rollback:
+            warnings.warn(
+                f'guard tripped ({reason}) at step {trainer.step_count} '
+                f'but rollback is DISABLED — training continues on '
+                f'suspect parameters', RuntimeWarning)
+            return False
+        if guard.counters['rollbacks'] + 1 > gcfg.restart_budget:
+            guard.diverged = True
+            _close_record()
+            raise TrainingFailed(
+                f'restart budget spent: {guard.counters["rollbacks"]} '
+                f'rollbacks already, guard tripped again ({reason}) at '
+                f'step {trainer.step_count}', **guard.counters)
+        checkpoint_manager.wait_until_finished()
+        tripped_at = trainer.step_count
+        state = _restore_state(trainer, checkpoint_manager)
+        trainer.restore(state)
+        guard.bump('rollbacks')
+        if gcfg.skip_window:
+            skipped = tripped_at - trainer.step_count
+            trainer.step_count = tripped_at
+            guard.bump('skipped_batches', skipped)
+            log(f'guard trip ({reason}): rolled back params to step '
+                f'{state[2]}, SKIPPED the {skipped}-step window')
+        else:
+            log(f'guard trip ({reason}): rolled back to step '
+                f'{trainer.step_count}, replaying')
+        sync_injections()
+        guard.save_counters(checkpoint_manager.directory)
+        return True
+
+    def _close_record():
+        sync_injections()
+        rec = guard.record(trainer.step_count, injector=injector)
+        if metric_logger is not None:
+            rec = metric_logger.log_record('guard', **rec)
+        else:
+            rec = dict(kind='guard', **rec)
+        history.append(rec)
+        return rec
+
+    def run_one_step(preemption, batch=None):
+        """One guarded optimizer step at the trainer's current index;
+        returns False when the loop must stop (preemption)."""
+        step_index = trainer.step_count
+        fire('step_dispatch', step=step_index)
+        if batch is None:
+            with trainer.phase_timer.phase('data'):
+                batch = _host_micro_batches(trainer, step_index)
+                if fire('step_batch', step=step_index) == 'nan':
+                    batch = poison_batch(batch)
+            preplaced = False
+        else:
+            preplaced = True
+        trainer.rng = step_train_rng(cfg.seed, step_index)
+        trainer.train_step(batch, preplaced=preplaced)
+        if step_hook is not None:
+            step_hook(trainer.step_count)
+        return not preemption.stop_requested
+
+    def check_and_checkpoint() -> str:
+        # telemetry_flush merges the window into the run-cumulative
+        # stats; a TRIPPED window must not stay merged (the rollback
+        # erases those steps from the trajectory, and all-NaN
+        # cumulative loss stats would make every guarded summary
+        # meaningless) — snapshot and restore on a trip. The flush
+        # RECORD keeps the poisoned window: that is the evidence.
+        prev_cum = (None if trainer._cum_metrics is None
+                    else {k: dict(v)
+                          for k, v in trainer._cum_metrics.items()})
+        flush = trainer.telemetry_flush(metric_logger)
+        history.append(flush)
+        verdict = guard.check_window(flush.get('window') or {})
+        if verdict == 'ok':
+            save_good(trainer.step_count)
+        else:
+            trainer._cum_metrics = prev_cum
+        return verdict
+
+    preempted = False
+    with PreemptionGuard() as preemption:
+        try:
+            if trainer.params is None:
+                # explicit init (param initializers depend on shapes
+                # and the seed, not batch values, so this is identical
+                # across control/chaos/resume arms)
+                trainer.init()
+            if needs_anchor:
+                # anchor checkpoint BEFORE the first step (see above)
+                save_good(trainer.step_count)
+            while trainer.step_count < num_steps:
+                segment_trip = None
+                if cfg.pipeline:
+                    segment_trip, stop = _pipelined_segment(
+                        trainer, num_steps, window, fire, run_one_step,
+                        check_and_checkpoint, preemption, injector,
+                        metric_logger, history, guard)
+                else:
+                    stop = False
+                    while trainer.step_count < num_steps and not stop:
+                        try:
+                            if not run_one_step(preemption):
+                                stop = True
+                        except Exception as e:  # noqa: BLE001 - an
+                            # injected/real dispatch fault is a trip,
+                            # not a crash (the rollback policy decides)
+                            segment_trip = f'step_error:{e}'
+                        if segment_trip is None and (
+                                trainer.step_count % window == 0
+                                or trainer.step_count >= num_steps):
+                            verdict = check_and_checkpoint()
+                            if verdict != 'ok':
+                                segment_trip = verdict
+                        if segment_trip is not None:
+                            break
+                if segment_trip is not None:
+                    if not rollback(segment_trip) and \
+                            segment_trip.startswith('step_error'):
+                        # rollback disabled AND the step itself raised:
+                        # skip the failing step instead of spinning on
+                        # it forever (the diverged verdict still lands)
+                        guard.bump('skipped_batches')
+                        trainer.step_count += 1
+                    continue
+                if stop or preemption.stop_requested:
+                    break
+            if preemption.stop_requested:
+                preempted = True
+                emergency_save(trainer.step_count)
+        except TrainingFailed:
+            raise
+        finally:
+            if cfg.telemetry and not preempted:
+                # residual flush + summary (one more sync at close)
+                try:
+                    history.append(trainer.telemetry_close(metric_logger))
+                except Exception:  # noqa: BLE001
+                    pass
+    if not preempted:
+        guard.diverged = guard.diverged or (
+            guard.last_verdict != 'ok' and not gcfg.rollback) or (
+            not _tree_finite(trainer.params))
+        rec = _close_record()
+        guard.save_counters(checkpoint_manager.directory)
+    else:
+        rec = None
+    return GuardResult(steps=trainer.step_count, preempted=preempted,
+                       diverged=guard.diverged, counters=dict(
+                           guard.counters), history=history,
+                       guard_record=rec)
+
+
+def _pipelined_segment(trainer, num_steps, window, fire, run_one_step,
+                       check_and_checkpoint, preemption, injector,
+                       metric_logger, history, guard):
+    """One producer/prefetch segment of the pipelined guarded loop:
+    deterministic per-index host batches (the `step_batch` nan site
+    fires at build, on the producer thread), `BatchProducer` with the
+    `batch_source` transient-fault site, `device_prefetch` honoring the
+    trainer's mesh. Returns (trip_reason_or_None, stop)."""
+    import itertools
+
+    from ..parallel.mesh import shard_batch
+    from .pipeline import BatchProducer, PipelineStats, device_prefetch
+    cfg = trainer.cfg
+    start = trainer.step_count
+
+    def source():
+        for i in itertools.count(start):
+            if i >= num_steps:
+                return
+            host = _host_micro_batches(trainer, i)
+            if fire('step_batch', step=i) == 'nan':
+                host = poison_batch(host)
+            yield host
+
+    place = None
+    if trainer.mesh is not None:
+        lead = 1 if cfg.accum_steps > 1 else 0
+        mesh = trainer.mesh
+
+        def place(b):  # noqa: E306 - closure over mesh/lead
+            return shard_batch(b, mesh, leading_axes=lead)
+
+    stats = PipelineStats(depth=cfg.prefetch_depth,
+                          capacity=cfg.producer_capacity)
+    gcfg = guard.cfg
+    trip, stop = None, False
+    with BatchProducer(source(), capacity=cfg.producer_capacity,
+                       fault_injector=injector,
+                       max_retries=gcfg.source_max_retries,
+                       retry_backoff_s=gcfg.source_retry_backoff_s,
+                       max_skips=gcfg.source_max_skips) as producer:
+        stats.bind_source(producer)
+        batches = device_prefetch(
+            producer, depth=cfg.prefetch_depth, sharding=place,
+            phase_timer=trainer.phase_timer, stats=stats)
+        for batch in batches:
+            try:
+                if not run_one_step(preemption, batch=batch):
+                    stop = True
+            except Exception as e:  # noqa: BLE001 - trip, not crash
+                trip = f'step_error:{e}'
+            if trip is None and (trainer.step_count % window == 0
+                                 or trainer.step_count >= num_steps):
+                verdict = check_and_checkpoint()
+                if metric_logger is not None:
+                    history.append(trainer._pipeline_record(
+                        stats, metric_logger))
+                if verdict != 'ok':
+                    trip = verdict
+            if trip is not None or stop:
+                break
+    return trip, stop

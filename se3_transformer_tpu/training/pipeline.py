@@ -79,23 +79,53 @@ class BatchProducer:
     blocks on the slow consumer instead of buffering the whole epoch in
     host RAM. Single consumer; `close()` (or the context manager) stops
     the thread and drains the queue.
+
+    Transient-fault tolerance (the training-side fault domain): a
+    source exception used to kill the run outright via
+    `BatchProducerError`. With ``max_retries > 0`` the pull is retried
+    with bounded exponential backoff (``retry_backoff_s`` doubling up
+    to ``retry_backoff_max_s``, interruptible by close()); once retries
+    are spent, ``max_skips > 0`` lets the producer SKIP the poison
+    batch (counted in ``skipped`` — surfaced in the `pipeline` record's
+    ``source`` section) and move on. Only a spent skip budget raises
+    `BatchProducerError`. Retry can re-pull a ``build_fn`` source at
+    the same index; a plain generator is DEAD after it raises (a
+    re-next would silently end the stream), so for iterator sources
+    retry/skip apply only to faults injected BEFORE the pull — the
+    ``fault_injector``'s ``batch_source`` site, fired per pull on the
+    producer thread, which is exactly how `make train-chaos-smoke`
+    exercises this path.
     """
 
     def __init__(self, source: Union[Iterable, Callable[[int], Any]],
-                 capacity: int = 4, name: str = 'batch-producer'):
+                 capacity: int = 4, name: str = 'batch-producer',
+                 max_retries: int = 0, retry_backoff_s: float = 0.05,
+                 retry_backoff_max_s: float = 2.0, max_skips: int = 0,
+                 fault_injector=None, fault_site: str = 'batch_source'):
         assert capacity >= 1, 'capacity must be >= 1'
+        self._build_fn = None
+        self._it = None
         if callable(source) and not hasattr(source, '__next__') \
                 and not hasattr(source, '__iter__'):
-            build_fn = source      # the genexp body evaluates lazily —
-            source = (build_fn(i) for i in itertools.count())
-        self._it = iter(source)
+            self._build_fn = source    # retries re-pull the same index
+        else:
+            self._it = iter(source)
         self.capacity = capacity
+        self.max_retries = int(max_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.retry_backoff_max_s = float(retry_backoff_max_s)
+        self.max_skips = int(max_skips)
+        self.fault_injector = fault_injector
+        self.fault_site = fault_site
         self._q: queue.Queue = queue.Queue(maxsize=capacity)
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
         self._exhausted = False
         self.puts = 0            # batches the producer finished building
         self.gets = 0            # batches the consumer received
+        self.retries = 0         # transient source errors retried away
+        self.skipped = 0         # poison batches dropped after retries
+        self._restartable = True  # last pull's failure was retry/skip-able
         self._thread = threading.Thread(target=self._worker, daemon=True,
                                         name=name)
         self._thread.start()
@@ -111,12 +141,85 @@ class BatchProducer:
                 continue
         return False
 
+    def _backoff_or_raise(self, attempts: int) -> int:
+        """One retry tick: raises (re-raise in the caller) once the
+        budget is spent, else sleeps the bounded backoff — via
+        Event.wait, so a close() interrupts it instead of leaking a
+        sleeping thread — and returns the new attempt count."""
+        if attempts >= self.max_retries or self._stop.is_set():
+            raise
+        self.retries += 1
+        backoff = min(self.retry_backoff_s * (2 ** attempts),
+                      self.retry_backoff_max_s)
+        self._stop.wait(backoff)
+        return attempts + 1
+
+    def _pull(self, index: int):
+        """One source pull with the transient-retry policy. Raises
+        StopIteration on exhaustion; re-raises the source error once
+        the retry budget is spent (the skip policy is the caller's).
+        Only RESTARTABLE failures retry: injector faults (raised
+        before the pull) and `build_fn` errors (the same index can be
+        re-pulled). A plain generator is DEAD once it raises — a
+        re-next would return StopIteration and silently truncate the
+        stream as clean exhaustion — so iterator-source errors fail
+        loud immediately, exactly like the pre-retry contract."""
+        attempts = 0
+        self._restartable = True
+        while True:
+            try:
+                if self.fault_injector is not None:
+                    self.fault_injector.fire(self.fault_site,
+                                             index=int(index))
+            except Exception:
+                attempts = self._backoff_or_raise(attempts)
+                continue
+            if self._build_fn is None:
+                try:
+                    return next(self._it)
+                except StopIteration:
+                    raise
+                except Exception:
+                    # the generator is dead now: no retry, and the
+                    # worker must not SKIP either (the next pull would
+                    # read StopIteration and truncate silently)
+                    self._restartable = False
+                    raise
+            try:
+                return self._build_fn(index)
+            except StopIteration:
+                raise
+            except Exception:
+                attempts = self._backoff_or_raise(attempts)
+
     def _worker(self):
+        index = 0
         try:
-            for batch in self._it:
+            while not self._stop.is_set():
+                try:
+                    batch = self._pull(index)
+                except StopIteration:
+                    return
+                except Exception as e:
+                    # skip = "drop the item at this index": only a
+                    # build_fn source maps indices to items, so only
+                    # there does bumping `skipped` describe a real
+                    # drop. An iterator source's pending item is still
+                    # queued in the generator — "skipping" it would
+                    # deliver every batch while the counter claimed a
+                    # loss — so injector faults there fail loud once
+                    # the retry budget is spent.
+                    if self._build_fn is not None \
+                            and self._restartable \
+                            and self.skipped < self.max_skips:
+                        self.skipped += 1
+                        index += 1
+                        continue     # poison batch dropped, move on
+                    raise e
                 if not self._put(batch):
                     return
                 self.puts += 1
+                index += 1
         except BaseException as e:  # re-raised on the consumer side
             self._error = e
         finally:
@@ -228,6 +331,15 @@ class PipelineStats:
     place_s: float = 0.0         # total time issuing device_put
     occupancy_sum: int = 0       # producer qsize observed at each pull
     pulls: int = 0
+    source: Optional[object] = None   # bound BatchProducer (live
+    #                                   retry/skip counters, see below)
+
+    def bind_source(self, producer):
+        """Attach the producer whose transient-fault counters
+        (`retries` retried pulls, `skipped` poison batches dropped)
+        the `pipeline` record should surface — read LIVE at snapshot
+        time, so every flush carries the current totals."""
+        self.source = producer
 
     def record_pull(self, waited_s: float, occupancy: Optional[int]):
         self.pulls += 1
@@ -259,7 +371,7 @@ class PipelineStats:
         return 'balanced'
 
     def snapshot(self) -> dict:
-        return dict(
+        out = dict(
             steps=self.gets,
             queue=dict(
                 capacity=self.capacity,
@@ -273,6 +385,11 @@ class PipelineStats:
                 host_wait_ms=round(self.host_wait_s * 1e3, 3),
                 place_ms=round(self.place_s * 1e3, 3)),
             verdict=self.verdict())
+        if self.source is not None:
+            out['source'] = dict(
+                retries=int(getattr(self.source, 'retries', 0)),
+                skipped=int(getattr(self.source, 'skipped', 0)))
+        return out
 
 
 def _make_placer(sharding) -> Callable[[Any], Any]:

@@ -128,6 +128,13 @@ _HEAVY_TESTS = {
     'test_engine_restore_time_quantization_and_mix_parity',
     'test_engine_fp8_mix_if_available',
     'test_fsdp_sharded_opt_state_train_and_restore',
+    # guardian tier (PR 14): the rollback-parity and kill-and-resume
+    # proofs each run a control arm + a chaos arm of the toy trainer
+    # (shared shapes, so the persistent jit cache amortizes them)
+    'test_guard_nan_rollback_replays_to_control_parity',
+    'test_guard_kill_and_resume_bit_exact_pipelined_donated',
+    'test_guard_kill_and_resume_bit_exact_fsdp',
+    'test_restart_budget_fails_loud_and_weakened_arm_diverges',
 }
 
 
@@ -197,6 +204,13 @@ _SLOW_TESTS = {
     # carries a hard overall deadline, but a distributed-runtime smoke
     # has no place in the timed gate either way
     'test_two_process_distributed_batch_assembly',
+    # test_guardian (PR 14): the fsdp kill-and-resume proof compiles
+    # its own dp-mesh control + chaos + resume programs (~40 s warm on
+    # this host); the fsdp restore re-placement itself stays tier-1 via
+    # test_fsdp_sharded_opt_state_train_and_restore, and the guardian's
+    # rollback/kill-resume contracts stay tier-1 via the single-device
+    # and pipelined+donated variants
+    'test_guard_kill_and_resume_bit_exact_fsdp',
 }
 
 

@@ -213,6 +213,86 @@ def test_place_with_rules_places_and_returns_specs():
 
 
 # --------------------------------------------------------------------- #
+# quantized trees: rules descend into QuantTensor q/scale leaves
+# (ROADMAP item 3 residue: quantized params used to replicate under tp)
+# --------------------------------------------------------------------- #
+def _quantized_model_like_tree():
+    """The model-like tree with the int8-quantizable weights actually
+    quantized (quant.rules: contract axis 0, per-output-channel
+    scales), exactly what `InferenceEngine(precision='int8_mix')`
+    hands the rule engine."""
+    from se3_transformer_tpu.quant.qtensor import quantize
+    return {
+        'layers_0': {
+            'to_q': {'w0': quantize(np.ones((8, 8), np.float32))},
+            'to_out': {'w0': quantize(np.ones((8, 8), np.float32))},
+            'w3': quantize(np.ones((16, 12, 8), np.float32)),
+            'w3_0_1': quantize(np.ones((16, 12, 8), np.float32)),
+            'b3': np.zeros((12, 8), np.float32),
+            'norm': {'g': np.zeros((8,), np.float32)},
+        },
+    }
+
+
+def test_tp_and_fsdp_rules_descend_into_quant_tensor_leaves():
+    """On a 2-axis (dp x tp) mesh, tp rules must shard the int8 `q`
+    storage exactly like the fp32 weight it replaced and carry the
+    per-output-channel `scale` with the output axis (replicated for
+    the row-parallel pair — the dequant epilogue runs on the full
+    post-psum output); fsdp shards q dim 0 and replicates the size-1-
+    dim-0 scales WITHOUT a demotion warning."""
+    from jax.sharding import Mesh
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ('dp', 'tp'))
+    params = _quantized_model_like_tree()
+
+    tp = _flat(match_partition_rules(tp_rules(), params, mesh=mesh))
+    # radial weights: q [16,12,8] + scale [1,12,8] both output-sharded
+    assert tp["['layers_0']['w3'].q"] == P(None, None, 'tp')
+    assert tp["['layers_0']['w3'].scale"] == P(None, None, 'tp')
+    assert tp["['layers_0']['w3_0_1'].q"] == P(None, None, 'tp')
+    assert tp["['layers_0']['w3_0_1'].scale"] == P(None, None, 'tp')
+    # column-parallel: q [8,8] and scale [1,8] shard the output axis
+    assert tp["['layers_0']['to_q']['w0'].q"] == P(None, 'tp')
+    assert tp["['layers_0']['to_q']['w0'].scale"] == P(None, 'tp')
+    # row-parallel: q row-shards, the per-OUTPUT scale replicates
+    assert tp["['layers_0']['to_out']['w0'].q"] == P('tp', None)
+    assert tp["['layers_0']['to_out']['w0'].scale"] == P()
+    assert tp["['layers_0']['b3']"] == P(None, 'tp')
+
+    with warnings.catch_warnings():
+        warnings.simplefilter('error')     # NO demotion warning allowed
+        fsdp = _flat(match_partition_rules(fsdp_rules(), params,
+                                           mesh=mesh))
+    assert fsdp["['layers_0']['w3'].q"] == P('dp')
+    assert fsdp["['layers_0']['w3'].scale"] == P()
+    assert fsdp["['layers_0']['to_q']['w0'].q"] == P('dp')
+    assert fsdp["['layers_0']['to_q']['w0'].scale"] == P()
+    # plain (non-quant) leaves keep the PR 8 layouts — no drift
+    assert fsdp["['layers_0']['b3']"] == P('dp')
+
+
+def test_quantized_tree_places_with_tp_rules_on_two_axis_mesh():
+    """place_with_rules over a quantized tree: the int8 q shards land
+    with half the output channels per tp shard, the scale rides along,
+    and dequantizing the reassembled tensor matches the host oracle."""
+    from jax.sharding import Mesh
+    from se3_transformer_tpu.quant.qtensor import dequantize, quantize
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ('dp', 'tp'))
+    w = np.arange(16 * 12 * 8, dtype=np.float32).reshape(16, 12, 8)
+    qt = quantize(w)
+    placed, specs = place_with_rules({'w3': qt}, mesh, 'tp')
+    assert specs['w3'].q == P(None, None, 'tp')
+    q = placed['w3'].q
+    assert q.dtype == np.int8
+    assert {s.data.shape for s in q.addressable_shards} == {(16, 12, 2)}
+    scale = placed['w3'].scale
+    assert {s.data.shape for s in scale.addressable_shards} == {(1, 12, 2)}
+    np.testing.assert_allclose(
+        np.asarray(q, np.float32) * np.asarray(scale),
+        dequantize(qt), rtol=0, atol=0)
+
+
+# --------------------------------------------------------------------- #
 # optimizer-state rules (ROADMAP item 5 first step: true-FSDP specs)
 # --------------------------------------------------------------------- #
 def test_fsdp_opt_state_mirrors_param_specs_on_two_axis_mesh():

@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke flash-smoke chaos-smoke train-chaos-smoke quant-smoke perf-gate clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke serve-fleet-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke flash-smoke chaos-smoke train-chaos-smoke quant-smoke perf-gate clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -78,6 +78,13 @@ chaos-smoke:       ## fault-domain gate (docs/ROBUSTNESS.md): seeded replica cra
 	python scripts/obs_report.py /tmp/chaos_smoke.jsonl --validate --require fault,serve --out /tmp/chaos_smoke_report.json
 	python scripts/perf_gate.py /tmp/chaos_smoke.jsonl
 	python scripts/chaos_smoke.py --weaken drop >/tmp/chaos_weaken.log 2>&1; test $$? -eq 1 || { echo "chaos-smoke weakened arm did NOT fire with rc=1 — a droppable fault class went undetected; output:"; cat /tmp/chaos_weaken.log; exit 1; }  # rc=1 is the gate FIRING on lost requests; any other rc (crash, argparse) fails loudly with the evidence
+
+serve-fleet-smoke: ## cross-host fleet gate (docs/ROBUSTNESS.md "Fleet fault domain"): 3 CPU host PROCESSES behind a FleetRouter — one SIGKILLed mid-run (requests redispatched cross-host, host quarantined, recovered via half-open probes after restart), seeded transport faults (latency + partition drop), and a poisoned-canary weight rollout that must AUTO-ROLL-BACK with zero sibling swaps — zero lost requests fleet-wide, zero post-warmup compiles, every host exits 0 on graceful SIGTERM, schema'd fleet records (--require fleet) judged by the fleet perf budgets; then the WEAKENED arm (host exclusion nulled) must exit rc==1, proving the gates fire
+	rm -f /tmp/fleet_chaos.jsonl
+	python scripts/fleet_chaos_smoke.py --metrics /tmp/fleet_chaos.jsonl --out /tmp/fleet_chaos_summary.json
+	python scripts/obs_report.py /tmp/fleet_chaos.jsonl --validate --require fleet --out /tmp/fleet_chaos_report.json
+	python scripts/perf_gate.py /tmp/fleet_chaos.jsonl
+	python scripts/fleet_chaos_smoke.py --weaken noexclude >/tmp/fleet_weaken.log 2>&1; test $$? -eq 1 || { echo "serve-fleet-smoke weakened arm did NOT fire with rc=1 — nulled host exclusion went undetected; output:"; cat /tmp/fleet_weaken.log; exit 1; }  # rc=1 is the gates FIRING on the dead host eating traffic; any other rc (crash, argparse) fails loudly with the evidence
 
 train-chaos-smoke: ## self-healing training gate (docs/ROBUSTNESS.md "Training fault domain"): an injected-NaN step + a real mid-run SIGTERM over the guarded elastic loop — the run must roll back (>=1 observed), exit resumable, resume, and finish BIT-EXACT vs an uninterrupted control arm with zero post-warmup recompiles; schema'd guard records (--require guard: injections >= 1, diverged == false), judged by the train-chaos perf budgets; then the WEAKENED arm (rollback nulled) must exit rc==1, proving the diverged gate fires
 	rm -f /tmp/train_chaos.jsonl

@@ -1,0 +1,456 @@
+"""Fleet chaos smoke: the CROSS-HOST fault domain under deterministic
+fire, with real processes dying.
+
+Usage:
+    python scripts/fleet_chaos_smoke.py [--hosts 3] [--requests 36]
+        [--buckets 8,16] [--batch-size 2] [--timeout-s 30]
+        [--max-retries 2] [--seed 0] [--kill-at 10]
+        [--canary-requests 6] [--metrics FLEET_CHAOS.jsonl]
+        [--out SUMMARY.json] [--weaken none|noexclude]
+
+Three `scripts/serve.py --host` worker PROCESSES (each a full PR 8/12
+stack: AOT engines, continuous batcher, router, breakers) serve a
+mixed-length stream through a `serving.fleet.FleetRouter` while the
+smoke injects, deterministically:
+
+  * a host DEATH   — host 0 is SIGKILLed mid-run (a real preemption: no
+    drain, no goodbye). Its in-flight and subsequent RPCs fail, the
+    fleet redispatches them CROSS-HOST (zero lost), heartbeat failures
+    walk the HOST breaker to quarantined, and after the smoke restarts
+    the process on the same port, half-open ping probes close the
+    breaker back — recovery observed, not assumed;
+  * transport flakiness — a seeded `FaultInjector` `transport` site
+    plans a latency spike and a partition-style drop on the fleet's
+    RPCs (same seed, same faults), so a cross-host retry is exercised
+    even before the kill;
+  * a POISONED CANARY — a rolling weight rollout (checkpoint step 1 ->
+    step 2 over the hosts' drain/swap contract) canaries on a host
+    started with `--poison-step 2`: the moment the canary restores the
+    new step, its every dispatch fails. The canary gate (pinned probe
+    traffic + the host's scraped serve evidence) must FAIL and the
+    fleet must AUTO-ROLL-BACK to step 1, leaving every other host
+    untouched on the old weights.
+
+Exit is non-zero unless ALL of:
+  * zero lost requests FLEET-WIDE (every submit — including the
+    sacrificial canary probes — resolves answered or structured-error);
+  * every non-probe in-range request is ANSWERED (redispatch must
+    actually pay the kill down, not just fail structurally);
+  * >= 1 HOST quarantine -> recovery transition observed;
+  * the rollout event shows the canary swapped to step 2, the gate
+    failing, an auto-rollback to step 1, and ZERO sibling swaps;
+  * the planned transport faults fired (latency + drop);
+  * zero post-warmup compiles on every host (scraped at the end);
+  * every host exits 0 on graceful SIGTERM (the shutdown satellite);
+  * the banked stream (run_meta + schema'd `fleet` records) validates.
+
+`--weaken noexclude` is the injection arm of the `make
+serve-fleet-smoke` pair: host exclusion is NULLED (placement ignores
+breaker state, retries stop avoiding the host that just failed) and
+the killed host never restarts — the dead lowest-id host keeps eating
+traffic, requests exhaust their budgets unanswered and no recovery is
+ever observed, so the run MUST exit rc==1, proving the gates fire
+rather than decorate. The make target asserts the pair.
+"""
+import argparse
+import atexit
+import json
+import os
+import shutil
+import signal
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description='multi-process cross-host fleet chaos gate (CPU)')
+    ap.add_argument('--hosts', type=int, default=3)
+    ap.add_argument('--requests', type=int, default=36,
+                    help='phase-A stream length (the kill lands inside)')
+    ap.add_argument('--post-requests', type=int, default=8,
+                    help='phase-C stream length (after the rollback the '
+                         'fleet must still answer everything)')
+    ap.add_argument('--buckets', type=str, default='8,16')
+    ap.add_argument('--batch-size', type=int, default=2)
+    ap.add_argument('--max-wait-ms', type=float, default=10.0)
+    ap.add_argument('--timeout-s', type=float, default=30.0)
+    ap.add_argument('--max-retries', type=int, default=2)
+    ap.add_argument('--pace-ms', type=float, default=30.0)
+    ap.add_argument('--seed', type=int, default=0)
+    ap.add_argument('--kill-at', type=int, default=10,
+                    help='SIGKILL host 0 after this many phase-A '
+                         'submits')
+    ap.add_argument('--restart-after-s', type=float, default=1.0)
+    ap.add_argument('--canary-requests', type=int, default=6)
+    ap.add_argument('--latency-budget-ms', type=float, default=30000.0,
+                    help='canary-gate latency ceiling (generous on a '
+                         'loaded CPU host — the poisoned canary fails '
+                         'on ANSWERS, not latency)')
+    ap.add_argument('--recovery-deadline-s', type=float, default=240.0,
+                    help='bound on waiting for the restarted host to '
+                         'warm up and close its breaker via probes')
+    ap.add_argument('--ckpt-dir', type=str, default=None)
+    ap.add_argument('--checkpoint', default=None, help=argparse.SUPPRESS)
+    ap.add_argument('--metrics', type=str, default=None)
+    ap.add_argument('--out', type=str, default=None)
+    ap.add_argument('--weaken', choices=('none', 'noexclude'),
+                    default='none',
+                    help="'noexclude': null host exclusion (placement "
+                         'ignores breaker state, retries stop avoiding '
+                         'the failed host) and skip the restart — the '
+                         'gates MUST fire (rc 1), proving they are '
+                         'live')
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    enable_compilation_cache()
+    import numpy as np
+
+    from serve import (
+        build_module_and_params, spawn_host, stop_host, wait_host_ready,
+    )
+    from se3_transformer_tpu.faults import FaultInjector
+    from se3_transformer_tpu.observability import MetricLogger
+    from se3_transformer_tpu.observability.report import (
+        summarize_fleet_records,
+    )
+    from se3_transformer_tpu.observability.schema import (
+        SchemaError, validate_stream,
+    )
+    from se3_transformer_tpu.serving import (
+        FleetRouter, HealthConfig, SocketTransport,
+    )
+    from se3_transformer_tpu.training.checkpoint import CheckpointManager
+
+    buckets = tuple(int(b) for b in args.buckets.split(','))
+    weakened = args.weaken == 'noexclude'
+    kill_host = 0       # lowest id: the weaken arm's tie-breaks land on
+    #                     it, so nulled exclusion keeps feeding it
+    canary = args.hosts - 1
+
+    # ---- the weight refs: step 1 = current, step 2 = rollout target -- #
+    cfg, _, params_old = build_module_and_params(args, buckets)
+    _, _, params_new = build_module_and_params(args, buckets,
+                                               seed=args.seed + 1)
+    ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix='fleet_ckpt_')
+    if args.ckpt_dir is None:
+        atexit.register(shutil.rmtree, ckpt_dir, ignore_errors=True)
+    mgr = CheckpointManager(ckpt_dir)
+    mgr.save(1, dict(params=params_old))
+    mgr.save(2, dict(params=params_new))
+    mgr.close()
+    print(f'checkpoints: step 1 (current) + step 2 (rollout target) '
+          f'in {ckpt_dir}')
+
+    # ---- spawn the host processes (canary carries the poison) -------- #
+    tmp = tempfile.mkdtemp(prefix='fleet_chaos_')
+    atexit.register(shutil.rmtree, tmp, ignore_errors=True)
+
+    def host_kw(i, port=0):
+        return dict(
+            port=port, buckets=args.buckets, batch_size=args.batch_size,
+            replicas=1, seed=args.seed, max_wait_ms=args.max_wait_ms,
+            timeout_s=args.timeout_s, max_retries=1,
+            checkpoint=ckpt_dir, checkpoint_step=1,
+            metrics=os.path.join(tmp, f'host_{i}.jsonl'),
+            poison_step=2 if i == canary else None)
+
+    print(f'spawning {args.hosts} host processes '
+          f'(canary={canary} poisoned at step 2)...')
+    procs = [spawn_host(i, **host_kw(i)) for i in range(args.hosts)]
+
+    def kill_everything():
+        for p in procs:
+            if p is not None and p.poll() is None:
+                p.kill()
+    atexit.register(kill_everything)
+
+    ports, sinks = [], []
+    for p in procs:
+        port, sink = wait_host_ready(p)
+        ports.append(port)
+        sinks.append(sink)
+    print(f'fleet up: hosts on ports {ports}')
+
+    # ---- the fleet front-end + the seeded transport fault plan ------- #
+    inj = FaultInjector(seed=args.seed)
+    inj.plan('transport', 'latency', every=11, latency_s=0.02)
+    inj.plan('transport', 'drop', at=(5,), match=dict(method='infer'))
+    transports = {i: SocketTransport('127.0.0.1', port,
+                                     fault_injector=inj)
+                  for i, port in enumerate(ports)}
+    health = HealthConfig(quarantine_after=3, recover_after=2,
+                          probe_backoff_s=0.25, probe_backoff_max_s=2.0)
+    logger = MetricLogger(args.metrics, run_meta=dict(
+        mode='fleet_chaos', hosts=args.hosts, ports=ports,
+        buckets=list(buckets), seed=args.seed, weaken=args.weaken,
+        kill_host=kill_host, canary=canary))
+    rng = np.random.RandomState(args.seed)
+    pending, probes = [], []
+    rollout_event = None
+    killed_at_t = None
+    restarted = False
+    ok = True
+
+    def mk_request():
+        b = buckets[int(rng.randint(0, len(buckets)))]
+        low = 1 if b == buckets[0] else buckets[0] + 1
+        length = int(rng.randint(low, b + 1))
+        return (rng.randint(0, cfg.num_tokens, size=length),
+                rng.normal(size=(length, 3)).astype(np.float32))
+
+    with FleetRouter(transports, health=health,
+                     max_retries=args.max_retries,
+                     default_timeout_s=args.timeout_s,
+                     heartbeat_every_s=0.2,
+                     stale_after_s=3.0) as fleet:
+        if weakened:
+            # THE WEAKENED ARM: the exclusion mechanism — quarantine
+            # filtering, failed-host avoidance, health-ranked placement
+            # — is a no-op. The dead host keeps eating traffic; the
+            # gates below MUST catch it (rc 1) or they are decoration.
+            print('WEAKENED GATE ARM: host exclusion nulled, no '
+                  'restart (this run must exit 1)')
+            fleet.host_exclusion = False
+
+        # scrape until the routing signals (and buckets) arrive
+        t0 = time.monotonic()
+        while fleet.buckets is None and time.monotonic() - t0 < 30:
+            fleet.pump()
+            time.sleep(0.05)
+        assert fleet.buckets == buckets, \
+            f'scraped buckets {fleet.buckets} != served {buckets}'
+
+        # ---- phase A: the stream, with a mid-run SIGKILL ------------- #
+        for i in range(args.requests):
+            if i == args.kill_at:
+                print(f'SIGKILL host {kill_host} (pid '
+                      f'{procs[kill_host].pid}) after {i} submits — a '
+                      f'real preemption, no drain')
+                os.kill(procs[kill_host].pid, signal.SIGKILL)
+                procs[kill_host].wait()
+                killed_at_t = time.monotonic()
+            if killed_at_t is not None and not restarted \
+                    and not weakened \
+                    and time.monotonic() - killed_at_t \
+                    >= args.restart_after_s:
+                print(f'restarting host {kill_host} on port '
+                      f'{ports[kill_host]}...')
+                procs[kill_host] = spawn_host(
+                    kill_host, **host_kw(kill_host,
+                                         port=ports[kill_host]))
+                restarted = True
+            tokens, coords = mk_request()
+            pending.append(fleet.submit(tokens, coords))
+            fleet.pump()
+            time.sleep(args.pace_ms / 1e3)
+        fleet.drain()
+        if killed_at_t is not None and not restarted and not weakened:
+            # the stream outran the restart delay — restart now, the
+            # recovery must still be OBSERVED via probes below
+            remaining = args.restart_after_s - (time.monotonic()
+                                                - killed_at_t)
+            if remaining > 0:
+                time.sleep(remaining)
+            print(f'restarting host {kill_host} on port '
+                  f'{ports[kill_host]} (post-stream)...')
+            procs[kill_host] = spawn_host(
+                kill_host, **host_kw(kill_host, port=ports[kill_host]))
+            restarted = True
+        answered_a = sum(1 for p in pending if p.ok)
+        print(f'phase A: {answered_a}/{len(pending)} answered, '
+              f'{fleet.cross_host_retries} cross-host retries, '
+              f'host {kill_host} state '
+              f'{fleet.health.state(kill_host)!r}')
+        logger.log_record('fleet', mirror=False,
+                          **fleet.record_body(pending, label='phase_a'))
+
+        # ---- phase B: wait for the restarted host's recovery --------- #
+        if restarted:
+            # the respawned process re-warms (persistent jit cache makes
+            # it quick) and must close its breaker via ping probes — the
+            # recovery is OBSERVED, never assumed
+            wait_host_ready(procs[kill_host])
+            print(f'host {kill_host} restarted and READY — waiting for '
+                  f'the breaker to close via probes')
+            t0 = time.monotonic()
+            while fleet.health.recoveries == 0 and \
+                    time.monotonic() - t0 < args.recovery_deadline_s:
+                fleet.pump()
+                time.sleep(0.1)
+            fleet.drain()
+            print(f'recoveries={fleet.health.recoveries}, host '
+                  f'{kill_host} state '
+                  f'{fleet.health.state(kill_host)!r}')
+
+        # ---- phase C: the canaried rollout (must auto-roll-back) ----- #
+        canary_traffic = [mk_request()
+                          for _ in range(args.canary_requests)]
+        rollout_event, probes = fleet.rollout(
+            dict(directory=ckpt_dir, step=2),
+            dict(directory=ckpt_dir, step=1),
+            canary_traffic, canary=canary,
+            latency_budget_ms=args.latency_budget_ms,
+            timeout_s=args.timeout_s)
+        pending += probes
+        print(f'rollout: canary={rollout_event["canary"]} '
+              f'tag={rollout_event.get("canary_tag")!r} '
+              f'gate={rollout_event.get("gate")} '
+              f'rolled_back={rollout_event.get("rolled_back")}')
+
+        # ---- phase D: the fleet must still serve after the rollback -- #
+        post = []
+        for _ in range(args.post_requests):
+            tokens, coords = mk_request()
+            post.append(fleet.submit(tokens, coords))
+            fleet.pump()
+            time.sleep(args.pace_ms / 1e3)
+        # the poisoned canary quarantined during the gate; give its
+        # probe recovery a bounded chance too (more breaker evidence)
+        t0 = time.monotonic()
+        while fleet.health.state(canary) == 'quarantined' \
+                and time.monotonic() - t0 < 30:
+            fleet.pump()
+            time.sleep(0.1)
+        fleet.drain()
+        pending += post
+        print(f'phase D: {sum(1 for p in post if p.ok)}/{len(post)} '
+              f'answered after the rollback')
+
+        # ---- final evidence: scraped stats + the banked record ------- #
+        final_stats = {}
+        for hid, t in transports.items():
+            try:
+                res = t.call('stats', timeout_s=5.0)
+                final_stats[hid] = res.get('stats') or {}
+            except Exception as e:
+                final_stats[hid] = dict(error=str(e))
+        body = fleet.record_body(pending, label='fleet_chaos')
+        logger.log_record('fleet', mirror=False, **body)
+    logger.close()
+
+    # ---- graceful shutdown: every host must exit 0 on SIGTERM -------- #
+    rcs = [stop_host(p) for p in procs]
+    print(f'host exit codes on graceful SIGTERM: {rcs}')
+
+    # ---- gates ------------------------------------------------------- #
+    probe_ids = {p.request_id for p in probes}
+    lost = [p.request_id for p in pending if not p.done]
+    if lost:
+        print(f'FAIL: {len(lost)} submitted requests LOST fleet-wide '
+              f'(resolved neither answered nor structured-error): '
+              f'{lost[:10]}')
+        ok = False
+    unanswered = [p.request_id for p in pending
+                  if not p.ok and p.request_id not in probe_ids]
+    if unanswered:
+        print(f'FAIL: {len(unanswered)} non-probe requests resolved '
+              f'unanswered — cross-host redispatch must pay the kill '
+              f'down: {unanswered[:10]}')
+        ok = False
+    killed_recovered = any(
+        e.get('from_state') == 'quarantined'
+        and e.get('host') == kill_host
+        for e in body['host_transitions'])
+    if body['recoveries'] < 1 or not killed_recovered:
+        print(f'FAIL: the SIGKILLed host {kill_host} was never '
+              f'observed recovering (quarantine -> live via probe '
+              f'after restart); transitions: '
+              f'{body["host_transitions"]}')
+        ok = False
+    if body['cross_host_retries'] < 1:
+        print('FAIL: zero cross-host retries — nothing was ever '
+              'redispatched onto a sibling host')
+        ok = False
+    if rollout_event is None or not rollout_event.get('rolled_back'):
+        print('FAIL: the poisoned canary rollout did NOT auto-roll-'
+              'back — the gate decorated instead of deciding')
+        ok = False
+    else:
+        if not str(rollout_event.get('canary_tag', '')).endswith('@2'):
+            print(f'FAIL: canary swap tag '
+                  f'{rollout_event.get("canary_tag")!r} — expected the '
+                  f'rollout target step 2')
+            ok = False
+        rb = rollout_event.get('rollback') or {}
+        if not str(rb.get('tag', '')).endswith('@1'):
+            print(f'FAIL: rollback tag {rb.get("tag")!r} — expected '
+                  f'the previous step 1')
+            ok = False
+        # EVERY non-canary host must show zero swaps — the restarted
+        # kill_host included (its fresh process counts from 0, so a
+        # rollout that wrongly rolled it would show there too)
+        sibling_swaps = {hid: (final_stats.get(hid) or {}).get('swaps')
+                         for hid in range(args.hosts) if hid != canary}
+        if any(s != 0 for s in sibling_swaps.values()):
+            print(f'FAIL: sibling hosts swapped during a rolled-back '
+                  f'canary: {sibling_swaps} (must all be 0)')
+            ok = False
+    by_site = inj.snapshot()['by_site']
+    for needed in ('transport:latency', 'transport:drop'):
+        if not by_site.get(needed):
+            print(f'FAIL: planned transport fault {needed!r} never '
+                  f'fired — the chaos proved less than it claims')
+            ok = False
+    compiles = {hid: (final_stats.get(hid) or {})
+                .get('post_warmup_compiles') for hid in final_stats}
+    if any(c is None or c != 0 for c in compiles.values()):
+        print(f'FAIL: post-warmup compiles per host {compiles} — the '
+              f'rollout/rollback swaps and the chaos must not break '
+              f'the AOT contract')
+        ok = False
+    if any(rc != 0 for rc in rcs):
+        print(f'FAIL: host exit codes {rcs} — graceful SIGTERM must '
+              f'drain, bank telemetry, and exit 0')
+        ok = False
+        for i, rc in enumerate(rcs):
+            if rc != 0:
+                print(f'--- host {i} tail ---')
+                print(''.join(sinks[i][-15:]) if i < len(sinks) else '?')
+    if args.metrics:
+        try:
+            info = validate_stream(args.metrics)
+            print(f'schema ok: {info["records"]} records '
+                  f'{info["kinds"]}')
+        except SchemaError as e:
+            print(f'FAIL: telemetry stream invalid: {e}')
+            ok = False
+
+    report = dict(
+        ok=ok,
+        weaken=args.weaken,
+        requests=dict(submitted=len(pending),
+                      answered=sum(1 for p in pending if p.ok),
+                      structured_failures=sum(
+                          1 for p in pending
+                          if p.done and p.error is not None),
+                      lost=len(lost), unanswered_non_probe=len(unanswered)),
+        fleet=summarize_fleet_records(
+            [dict(body, kind='fleet')]),
+        rollout=rollout_event,
+        injections=by_site,
+        host_rcs=rcs,
+        post_warmup_compiles=compiles,
+    )
+    print(json.dumps(report, indent=2, default=str))
+    if args.out:
+        with open(args.out, 'w') as f:
+            json.dump(report, f, indent=2, default=str)
+        print(f'report -> {args.out}')
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

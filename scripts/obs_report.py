@@ -254,6 +254,39 @@ def _gate_guard(records):
     return True
 
 
+def _gate_fleet(records):
+    fleets = [r for r in records if r.get('kind') == 'fleet']
+    if not fleets:
+        print('FLEET GATE: no fleet records in the stream (was the run '
+              'served through a FleetRouter — '
+              'scripts/fleet_chaos_smoke.py / serve.py --fleet?)',
+              file=sys.stderr)
+        return False
+    last = fleets[-1]
+    if not last.get('host_transitions'):
+        print('FLEET GATE: empty host_transitions log in the final '
+              'fleet record — a fleet record where no host breaker '
+              'ever moved proves nothing was exercised',
+              file=sys.stderr)
+        return False
+    lost = last.get('lost_requests')
+    if lost != 0:
+        print(f'FLEET GATE: lost_requests={lost!r} — every submit must '
+              f'resolve answered-or-structured-error FLEET-WIDE across '
+              f'host deaths, redispatches and rollouts (zero-lost '
+              f'contract)', file=sys.stderr)
+        return False
+    print(f"fleet gate ok: {len(fleets)} fleet records, "
+          f"{len(last.get('hosts') or {})} hosts, "
+          f"{len(last['host_transitions'])} host transitions / "
+          f"{last.get('recoveries', 0)} recoveries, "
+          f"{last.get('cross_host_retries', 0)} cross-host retries, "
+          f"{(last.get('rollouts') or {}).get('count', 0)} rollout "
+          f"events / {last.get('rollbacks', 0)} rollbacks, 0 lost",
+          file=sys.stderr)
+    return True
+
+
 def _gate_so2_sweep(records):
     sweeps = [r for r in records if r.get('kind') == 'so2_sweep']
     if not sweeps:
@@ -356,7 +389,7 @@ _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       profile=_gate_profile, serve=_gate_serve,
                       so2_sweep=_gate_so2_sweep, flash=_gate_flash,
                       fault=_gate_fault, guard=_gate_guard,
-                      quant_ab=_gate_quant_ab)
+                      fleet=_gate_fleet, quant_ab=_gate_quant_ab)
 
 
 def main(argv=None):
@@ -384,7 +417,9 @@ def main(argv=None):
                          'per-bucket latency percentiles present and '
                          'a nonzero answered count; fault: injections '
                          'present and zero lost requests; guard: '
-                         'injections present and diverged == false) '
+                         'injections present and diverged == false; '
+                         'fleet: host-breaker transitions present and '
+                         'zero lost requests fleet-wide) '
                          'and exits non-zero on failure')
     # legacy aliases for the unified --require flag (kept: Makefiles and
     # session scripts in the wild still pass them)

@@ -75,10 +75,14 @@ DEFAULT_BUDGETS = os.path.join(REPO, 'PERF_BUDGETS.json')
 # TRAIN_CHAOS.jsonl: the banked `make train-chaos-smoke` self-healing
 # training stream, so the zero-divergence contract, the observed
 # rollback, and the nonzero-injections proof bit are judged too.
+# FLEET_CHAOS.jsonl: the banked `make serve-fleet-smoke` cross-host
+# stream, so the fleet-wide zero-lost contract, the observed host
+# quarantine->recovery, and the canary auto-rollback are judged too.
 DEFAULT_RECORDS = ('BENCH_r05.json', 'WIDTH_TABLE.jsonl',
                    'SERVE_MULTI.jsonl', 'SO2_SWEEP.jsonl',
                    'FLASH_AB.jsonl', 'CHAOS_SMOKE.jsonl',
-                   'QUANT_AB.jsonl', 'TRAIN_CHAOS.jsonl')
+                   'QUANT_AB.jsonl', 'TRAIN_CHAOS.jsonl',
+                   'FLEET_CHAOS.jsonl')
 
 
 # --------------------------------------------------------------------- #

@@ -15,6 +15,13 @@ checkpoint, on demand, reproducibly. `FaultInjector` is that something
     step, and path on the post-write site, where a `corrupt` plan
     tears the just-written checkpoint — the preemption-mid-write
     scenario `restore`'s integrity fallback exists for);
+  * `transport`         — `serving.transport` fires it before every RPC
+    a fleet front-end issues (ctx: method, host): `latency` plans model
+    a slow link, `exception` plans a reset connection, and the
+    cooperative `drop` kind models a PARTITION — the transport sees
+    'drop' and raises `TransportError` without ever sending, so the
+    fleet-chaos smoke's RPC flakiness is seeded and deterministic, not
+    emergent from process timing;
   * training sites (training.guardian / training.pipeline):
     `step_dispatch` fires before every guarded optimizer step (ctx:
     step — exception plans walk the rollback path a real device fault
@@ -38,7 +45,11 @@ Fault kinds:
     `fire()`; the call site poisons its own data (the training
     guardian multiplies the step's batch coords by NaN, so a genuine
     non-finite loss walks the real jitted step — the injector cannot
-    reach into a compiled program, so the site cooperates).
+    reach into a compiled program, so the site cooperates);
+  * `drop`      — COOPERATIVE: record the firing and return 'drop'; the
+    call site discards its own message (a transport raises
+    `TransportError` without sending — a network partition looks like
+    silence at the caller, not a raised exception inside it).
 
 `fire()` returns the kind that acted ('exception' never returns — it
 raises) or None when no plan triggered; only cooperative kinds need
@@ -62,12 +73,13 @@ from __future__ import annotations
 
 import os
 import random
+import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
 __all__ = ['FaultInjector', 'InjectedFault']
 
-FAULT_KINDS = ('exception', 'latency', 'corrupt', 'nan')
+FAULT_KINDS = ('exception', 'latency', 'corrupt', 'nan', 'drop')
 
 
 class InjectedFault(RuntimeError):
@@ -156,6 +168,12 @@ class FaultInjector:
         self.sleep = sleep
         self._plans: List[_Plan] = []
         self.injected: List[dict] = []   # JSON-safe firing log
+        # fire() is called concurrently at the `transport` site (the
+        # fleet's dispatch/heartbeat/probe pool threads share one
+        # injector): plan selection, counters, and the rng must stay
+        # serialized or at=/every= firings drift run-to-run and the
+        # "same seed, same faults" determinism claim is false
+        self._lock = threading.Lock()
 
     def plan(self, site: str, kind: str = 'exception', **kw) -> '_Plan':
         p = _Plan(site, kind, **kw)
@@ -171,36 +189,49 @@ class FaultInjector:
         unwinds. Returns the kind that acted (None when no plan
         triggered) — cooperative kinds ('nan') rely on the caller
         reading it."""
-        for plan in self._plans:
-            if plan.site != site:
-                continue
-            if any(ctx.get(k) != v for k, v in plan.match.items()):
-                continue
-            if not plan.wants(self.rng):
-                continue
-            plan.fires += 1
-            event = dict(site=site, kind=plan.kind, call=plan.calls,
-                         **{k: v for k, v in ctx.items()
-                            if isinstance(v, (str, int, float, bool))})
-            self.injected.append(event)
-            if plan.kind == 'latency':
-                event['latency_s'] = plan.latency_s
-                self.sleep(plan.latency_s)
-            elif plan.kind == 'corrupt':
-                path = ctx.get('path')
-                assert path, f'corrupt plan at {site} needs ctx path='
-                event['torn'] = corrupt_path(path, plan.frac)
-            elif plan.kind == 'nan':
-                pass     # cooperative: the caller poisons on 'nan'
-            else:
-                raise InjectedFault(
-                    site, f'{plan.kind} (call {plan.calls})', **ctx)
-            # one action per fire: later plans for this site keep
-            # their counters (they were not consulted) and may trigger
-            # on a future call — without this, stacked latency plans
-            # would sleep twice and a latency+exception pair would do
-            # both on one call, violating the documented contract
-            return plan.kind
+        # decide + record under the lock (counters/rng/log serialized —
+        # concurrent transport-site fires must not make an at=(5,) plan
+        # double-fire or skip); ACT outside it (a latency sleep held
+        # under the lock would serialize every concurrent RPC behind
+        # the injected one, distorting the very timing being tested)
+        fired = None
+        with self._lock:
+            for plan in self._plans:
+                if plan.site != site:
+                    continue
+                if any(ctx.get(k) != v for k, v in plan.match.items()):
+                    continue
+                if not plan.wants(self.rng):
+                    continue
+                plan.fires += 1
+                event = dict(site=site, kind=plan.kind, call=plan.calls,
+                             **{k: v for k, v in ctx.items()
+                                if isinstance(v, (str, int, float, bool))})
+                self.injected.append(event)
+                # one action per fire: later plans for this site keep
+                # their counters (they were not consulted) and may
+                # trigger on a future call — without this, stacked
+                # latency plans would sleep twice and a
+                # latency+exception pair would do both on one call,
+                # violating the documented contract
+                fired = (plan, event)
+                break
+        if fired is None:
+            return None
+        plan, event = fired
+        if plan.kind == 'latency':
+            event['latency_s'] = plan.latency_s
+            self.sleep(plan.latency_s)
+        elif plan.kind == 'corrupt':
+            path = ctx.get('path')
+            assert path, f'corrupt plan at {site} needs ctx path='
+            event['torn'] = corrupt_path(path, plan.frac)
+        elif plan.kind in ('nan', 'drop'):
+            pass         # cooperative: the caller acts on the kind
+        else:
+            raise InjectedFault(
+                site, f'{plan.kind} (call {event["call"]})', **ctx)
+        return plan.kind
 
     # ------------------------------------------------------------------ #
     @property

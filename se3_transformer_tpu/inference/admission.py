@@ -87,27 +87,42 @@ class RequestFailed(Exception):
 
 
 def retries_exhausted_error(attempts: int,
-                            cause: Optional[BaseException] = None
+                            cause: Optional[BaseException] = None,
+                            retry_after_s: Optional[float] = None
                             ) -> RequestFailed:
+    """`retry_after_s` is the same machine-readable backoff hint an
+    overload `RequestRejected` carries (the Router's `_fail_request`
+    stamps its queue-depth estimate when the caller has none) — a
+    terminal failure without it invites the client to hot-loop the
+    struggling fleet it just fell out of."""
+    detail = dict(
+        attempts=int(attempts),
+        cause=f'{type(cause).__name__}: {cause}' if cause is not None
+        else None)
+    if retry_after_s is not None:
+        detail['retry_after_s'] = round(max(0.0, float(retry_after_s)), 4)
     return RequestFailed(
         RETRIES_EXHAUSTED,
         f'request failed on every replica it was dispatched to '
         f'({attempts} attempt{"s" if attempts != 1 else ""}); the retry '
         f'budget is spent',
-        attempts=int(attempts),
-        cause=f'{type(cause).__name__}: {cause}' if cause is not None
-        else None)
+        **detail)
 
 
 def deadline_error(waited_s: float, timeout_s: float,
-                   attempts: int = 0) -> RequestFailed:
+                   attempts: int = 0,
+                   retry_after_s: Optional[float] = None) -> RequestFailed:
+    detail = dict(
+        waited_s=round(float(waited_s), 4),
+        timeout_s=round(float(timeout_s), 4),
+        attempts=int(attempts))
+    if retry_after_s is not None:
+        detail['retry_after_s'] = round(max(0.0, float(retry_after_s)), 4)
     return RequestFailed(
         DEADLINE,
         f'request deadline expired after {waited_s:.3f}s '
         f'(timeout {timeout_s:.3f}s) before a dispatch could answer it',
-        waited_s=round(float(waited_s), 4),
-        timeout_s=round(float(timeout_s), 4),
-        attempts=int(attempts))
+        **detail)
 
 
 class AdmissionController:

@@ -343,6 +343,36 @@ def summarize_profile_records(records: List[dict]) -> dict:
     return dict(programs=len(programs), by_program=programs)
 
 
+def summarize_fleet_records(records: List[dict]) -> dict:
+    """Reduce fleet records (serving.fleet.FleetRouter.record_body
+    rows) to the surfaced view: the final record's per-host states,
+    transition/recovery counts, cross-host retry + rollout/rollback
+    evidence, and the load-bearing zero-lost verdict (counters are
+    cumulative, so the last record carries the run's story)."""
+    fleets = [r for r in records if r.get('kind', 'fleet') == 'fleet']
+    if not fleets:
+        return dict(records=0)
+    last = fleets[-1]
+    hosts = last.get('hosts') or {}
+    return dict(
+        records=len(fleets),
+        label=last.get('label'),
+        hosts={hid: snap.get('state') for hid, snap in hosts.items()},
+        host_transitions=len(last.get('host_transitions') or []),
+        recoveries=last.get('recoveries'),
+        cross_host_retries=last.get('cross_host_retries'),
+        request_failures=last.get('request_failures'),
+        timeouts=last.get('timeouts'),
+        heartbeats=last.get('heartbeats'),
+        rollouts=(last.get('rollouts') or {}).get('count'),
+        rollbacks=last.get('rollbacks'),
+        submitted=last.get('submitted'),
+        answered=last.get('answered'),
+        lost_requests=last.get('lost_requests'),
+        zero_lost=last.get('lost_requests') == 0,
+    )
+
+
 def summarize(records: List[dict], anchor: Optional[float] = None,
               code_rev: Optional[str] = None):
     """Auto-detect the stream species and summarize. A mixed stream is

@@ -107,6 +107,24 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    chaos-smoke` and obs_report --require fault gate
                    on it, and a fault record with zero injections
                    proves nothing).
+  fleet            cross-host fault-domain evidence for one fleet run
+                   (serving.fleet.FleetRouter.record_body, exercised by
+                   scripts/fleet_chaos_smoke.py): hosts (per-host-id
+                   breaker snapshot + last scraped routing signals),
+                   host_transitions (the HOST-level breaker moves) +
+                   recoveries (host quarantine -> live count, e.g. a
+                   SIGKILLed process restarting and closing its breaker
+                   via probe), cross_host_retries (redispatches onto
+                   sibling hosts), request_failures / timeouts,
+                   heartbeats ({ok, failed, stale_marks}), rollouts
+                   ({count, events} — canaried weight-rollout evidence
+                   incl. the gate verdicts) + rollbacks (auto-roll-back
+                   count), and the load-bearing verdict: lost_requests
+                   (submits that resolved neither answered nor
+                   structured-error FLEET-WIDE — MUST be 0; `make
+                   serve-fleet-smoke` and obs_report --require fleet
+                   gate on it, and a fleet record with an empty
+                   host_transitions log proves nothing was exercised).
   quant_ab         fp32-vs-quantized-mix serving A/B
                    (bench.quant_main via scripts/quant_smoke.py): mix
                    (the quant.rules precision mix), buckets (per-bucket
@@ -150,7 +168,7 @@ SCHEMA_VERSION = 1
 
 KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
                'serve', 'tune', 'comm', 'cost', 'profile', 'so2_sweep',
-               'flash', 'fault', 'guard', 'quant_ab', 'summary')
+               'flash', 'fault', 'guard', 'fleet', 'quant_ab', 'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
@@ -199,6 +217,14 @@ _REQUIRED = {
     'guard': ('run_id', 'step', 'trips', 'rollbacks', 'restarts',
               'skipped_batches', 'preemptions', 'injections_total',
               'diverged'),
+    # lost_requests is the load-bearing field of the CROSS-HOST
+    # fault-domain contract: a fleet record that cannot say whether
+    # every submit resolved answered-or-structured-error across host
+    # deaths, redispatches and a canaried rollout proves nothing (and
+    # an empty host_transitions log proves nothing was exercised)
+    'fleet': ('run_id', 'label', 'hosts', 'host_transitions',
+              'recoveries', 'cross_host_retries', 'request_failures',
+              'timeouts', 'rollouts', 'rollbacks', 'lost_requests'),
     # the memory ratio + the parity/equivariance figures are the
     # load-bearing quartet of the quantized-serving contract: a record
     # that cannot say the mix is smaller, implementation-faithful, AND
@@ -231,6 +257,8 @@ _FAULT_COUNTERS = ('injections_total', 'recoveries', 'retries',
                    'request_failures', 'timeouts', 'lost_requests')
 _GUARD_COUNTERS = ('trips', 'rollbacks', 'restarts', 'skipped_batches',
                    'preemptions', 'injections_total')
+_FLEET_COUNTERS = ('recoveries', 'cross_host_retries', 'request_failures',
+                   'timeouts', 'rollbacks', 'lost_requests')
 
 _COST_SOURCES = ('cost_analysis', 'hlo_estimate', 'unavailable')
 _COST_MEMORY_REQUIRED = ('argument_bytes', 'output_bytes', 'temp_bytes')
@@ -373,6 +401,43 @@ def validate_record(rec: dict, index=None) -> dict:
                     or 'to_state' not in e:
                 _fail(index, f'fault.health_transitions entries must '
                              f'carry from_state/to_state, got {e!r}')
+    if kind == 'fleet':
+        hosts = rec['hosts']
+        if not isinstance(hosts, dict) or not hosts:
+            _fail(index, 'fleet.hosts must be a non-empty object '
+                         '(host id -> breaker snapshot + scraped '
+                         'signals)')
+        for hid, snap in hosts.items():
+            if not isinstance(snap, dict) \
+                    or snap.get('state') not in _HEALTH_STATES:
+                _fail(index, f'fleet.hosts[{hid!r}] must carry a state '
+                             f'in {_HEALTH_STATES}')
+        if not isinstance(rec['host_transitions'], list):
+            _fail(index, 'fleet.host_transitions must be a list (the '
+                         'host-breaker evidence log, empty when clean)')
+        for e in rec['host_transitions']:
+            if not isinstance(e, dict) or 'from_state' not in e \
+                    or 'to_state' not in e:
+                _fail(index, f'fleet.host_transitions entries must '
+                             f'carry from_state/to_state, got {e!r}')
+        for field in _FLEET_COUNTERS:
+            val = rec[field]
+            if not isinstance(val, int) or isinstance(val, bool) \
+                    or val < 0:
+                _fail(index, f'fleet.{field} must be a non-negative '
+                             f'int, got {val!r}')
+        rollouts = rec['rollouts']
+        if not isinstance(rollouts, dict) \
+                or not isinstance(rollouts.get('count'), int) \
+                or not isinstance(rollouts.get('events'), list):
+            _fail(index, f'fleet.rollouts must carry an int count and '
+                         f'an events list, got {rollouts!r}')
+        for e in rollouts['events']:
+            if not isinstance(e, dict) or 'canary' not in e \
+                    or 'passed' not in e:
+                _fail(index, f'fleet.rollouts.events entries must '
+                             f'carry canary/passed (the gate verdict '
+                             f'IS the evidence), got {e!r}')
     if kind == 'guard':
         for field in _GUARD_COUNTERS + ('step',):
             val = rec[field]

@@ -33,10 +33,26 @@ spans chips (TP/FSDP) while DP replicas multiply throughput.
     propagates per-request deadlines, and folds it all into the
     `serve`/`fault` records. Chaos gate: `make chaos-smoke`.
 
-Entry point: `scripts/serve.py --replicas N`; smoke gates:
-`make serve-multi-smoke`, `make chaos-smoke`.
+  * `transport` + `fleet` — the CROSS-HOST tier (ROADMAP item 5): a
+    minimal pluggable RPC transport (in-process `LocalTransport` for
+    tests, newline-JSON `SocketTransport` for real processes),
+    `HostServer` exposing one host's router behind five JSON-safe
+    methods, and `FleetRouter` — the PR 12 breaker lifted to HOST
+    granularity (RPC outcomes + heartbeat staleness drive it, half-open
+    `ping` probes close it), health-aware placement on scraped per-host
+    signals, cross-host retry-with-redispatch with deadline
+    propagation, and canaried weight rollouts that AUTO-ROLL-BACK on a
+    failed canary gate. Chaos gate: `make serve-fleet-smoke`.
+
+Entry point: `scripts/serve.py --replicas N` (one host),
+`--fleet N` / `--host` (many); smoke gates: `make serve-multi-smoke`,
+`make chaos-smoke`, `make serve-fleet-smoke`.
 """
+from .fleet import FleetRouter, HostServer  # noqa: F401
 from .health import HealthConfig, HealthMonitor, ReplicaHealth  # noqa: F401
 from .replica import ContinuousBatcher, ReplicaWorker  # noqa: F401
 from .router import Router  # noqa: F401
 from .telemetry import RouterTelemetry  # noqa: F401
+from .transport import (  # noqa: F401
+    LocalTransport, SocketTransport, TransportError, serve_socket,
+)

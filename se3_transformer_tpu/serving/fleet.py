@@ -1,0 +1,961 @@
+"""Cross-host serving control plane: a fleet of per-host routers.
+
+PR 8/12 finished the single-host story — each process owns its
+replicas, so one host preemption is a total outage. This module is the
+tier above (ROADMAP item 5): each **host** (one process running a
+`Router` over its replicas) becomes a FAULT DOMAIN behind a minimal
+RPC surface, and a `FleetRouter` front-end routes across them:
+
+  * `HostServer` — wraps one host's `Router` behind five JSON-safe RPC
+    methods (`ping` / `stats` / `infer` / `swap` / `drain`). A single
+    serve-loop thread owns ALL router interactions (submit, pump,
+    deadline flushes, swaps) — RPC threads only enqueue and wait — so
+    the PR 8 router stays single-threaded exactly as its tests pin it.
+    `stats` is the routing signal: per-bucket depth, cumulative p99,
+    precision mixes, retry/failure counters, post-warmup compiles —
+    scraped straight off the existing `Router`/`RouterTelemetry`
+    surfaces, no second bookkeeping.
+
+  * `FleetRouter` — the front-end. The PR 12 breaker state machine
+    (`serving.health.HealthMonitor`) lifted one level: one breaker per
+    HOST, driven by RPC outcomes and heartbeat staleness (healthy ->
+    degraded -> quarantined; recovery via exponential-backoff half-open
+    `ping` probes from `pump()` — a SIGKILLed host that restarts on its
+    port closes its breaker through probe traffic, no operator).
+    Placement is health-aware least-loaded over the scraped signals
+    (fleet-side in-flight RPCs + host-reported queue depth, degraded
+    after healthy, p99 tie-break). Failed RPCs redispatch CROSS-HOST
+    (bounded by `max_retries`, excluding the host that just failed) and
+    deadlines propagate as remaining budget in the payload; after the
+    budget, requests resolve through the `_fail_request` choke point —
+    the same zero-lost contract the single-host router carries, now
+    fleet-wide (and the same weaken surface: the fleet-chaos smoke
+    nulls `host_exclusion` to prove the gate fires).
+
+  * **Canaried rollout** — `rollout(new_ref, rollback_ref, traffic)`
+    reuses the hosts' drain/swap contract: swap ONE canary host, drive
+    pinned probe traffic through it, gate on its serve evidence
+    (every probe answered, zero lost, latency within budget, zero new
+    host-side structured failures), then roll the rest — or AUTO
+    ROLL-BACK the canary to `rollback_ref` and leave the fleet on the
+    old weights. Every decision lands in `rollout_events` (the `fleet`
+    record's rollout evidence).
+
+The whole tier is telemetry-first: `record_body()` assembles the new
+schema'd `fleet` record — per-host breaker snapshots + scraped stats,
+host transitions, cross-host retries, rollout/rollback events,
+heartbeat accounting, and the load-bearing fleet-wide `lost_requests`.
+`make serve-fleet-smoke` (scripts/fleet_chaos_smoke.py) gates it.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import warnings
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..inference.admission import (
+    RequestFailed, RequestRejected, deadline_error, fit_bucket,
+    oversize_error, retries_exhausted_error,
+)
+from ..inference.batching import PendingResult
+from .health import QUARANTINED, HealthConfig, HealthMonitor
+from .router import Router
+from .transport import TransportError
+
+__all__ = ['HostServer', 'FleetRouter']
+
+# host error codes the fleet treats as a HOST failure (redispatch +
+# breaker) rather than a request verdict: the host's own retry budget
+# spending means its replicas are failing; 'internal'/'host_timeout'
+# mean the host process itself is sick
+_HOST_FAILURE_CODES = ('retries_exhausted', 'internal', 'host_timeout')
+
+
+class _Call:
+    __slots__ = ('method', 'payload', 'event', 'response')
+
+    def __init__(self, method: str, payload: dict):
+        self.method = method
+        self.payload = payload
+        self.event = threading.Event()
+        self.response: Optional[dict] = None
+
+    def respond(self, response: dict):
+        self.response = response
+        self.event.set()
+
+
+class HostServer:
+    """One host's RPC surface over its `Router`.
+
+        server = HostServer(router, host_id=0, telemetry=tele)
+        server.handle('infer', dict(tokens=[...], coords=[...]))
+        server.handle('swap', dict(directory=ckpt_dir, step=None))
+        server.stop()        # drains the router, joins the loop
+
+    A dedicated serve-loop thread owns the router: it dequeues calls,
+    submits infers, pumps (deadline flushes, retries, probes), resolves
+    watched requests, and periodically flushes the telemetry — RPC
+    threads never touch router state. `handle` is therefore safe from
+    any number of transport threads.
+
+    `on_swap(payload, events)` is an optional hook invoked (on the loop
+    thread) after a completed swap — the chaos harness uses it to arm a
+    deterministic poison against the step the canary just restored.
+    """
+
+    METHODS = ('ping', 'stats', 'infer', 'swap', 'drain')
+
+    def __init__(self, router: Router, host_id: int = 0, *,
+                 telemetry=None, clock: Callable[[], float] = time.monotonic,
+                 default_timeout_s: float = 30.0,
+                 flush_every_batches: int = 8,
+                 on_swap: Optional[Callable] = None):
+        self.router = router
+        self.host_id = int(host_id)
+        self.telemetry = telemetry
+        self.clock = clock
+        self.default_timeout_s = float(default_timeout_s)
+        self.flush_every_batches = int(flush_every_batches)
+        self.on_swap = on_swap
+        self.started_at = clock()
+        self.calls: Dict[str, int] = {m: 0 for m in self.METHODS}
+        # handle() runs on arbitrary transport threads (one per socket
+        # connection) — the per-method counters need their own lock
+        self._calls_lock = threading.Lock()
+        self._pump_errors_seen: set = set()
+        self._inbox: 'queue.Queue[_Call]' = queue.Queue()
+        self._stop = threading.Event()
+        self._flushed_at_batches = 0
+        self._thread = threading.Thread(
+            target=self._loop, name=f'host{self.host_id}-serve',
+            daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------ #
+    def handle(self, method: str, payload: Optional[dict] = None,
+               timeout_s: Optional[float] = None) -> dict:
+        """Transport entry (any thread): enqueue onto the serve loop,
+        wait for the response. The wait is bounded by the request's own
+        timeout budget plus slack — a wedged loop answers
+        `host_timeout`, which the fleet counts as a host failure."""
+        if method not in self.METHODS:
+            return dict(ok=False, error=dict(
+                code='unknown_method',
+                message=f'{method!r} not in {self.METHODS}'))
+        with self._calls_lock:
+            self.calls[method] = self.calls.get(method, 0) + 1
+        if method == 'ping':
+            # fast path off the serve loop: ping answers PROCESS
+            # liveness, so a half-open probe can close the breaker even
+            # while the loop is inside a long synchronous dispatch —
+            # traffic then re-judges the host on real outcomes
+            now = self.clock()
+            return dict(ok=True, host=self.host_id, t=round(now, 4),
+                        uptime_s=round(now - self.started_at, 3))
+        call = _Call(method, dict(payload or {}))
+        self._inbox.put(call)
+        budget = timeout_s
+        if budget is None:
+            budget = call.payload.get('timeout_s')
+        wait = (float(budget) if budget is not None
+                else self.default_timeout_s) + 5.0
+        if not call.event.wait(timeout=max(0.05, wait)):
+            return dict(ok=False, error=dict(
+                code='host_timeout',
+                message=f'{method!r} timed out after {wait:.1f}s inside '
+                        f'host {self.host_id}\'s serve loop'))
+        return call.response
+
+    def stop(self, drain: bool = True):
+        """End the serve loop (then drain the router by default, so
+        everything already admitted answers — the graceful-shutdown
+        path `scripts/serve.py --host` walks on SIGTERM)."""
+        self._stop.set()
+        self._thread.join(timeout=30.0)
+        if self._thread.is_alive():
+            # the loop is wedged (a watched request behind a stuck
+            # runner) and STILL OWNS the router — draining from this
+            # thread would break the single-owner invariant and mutate
+            # batcher/retry state concurrently. Loud skip instead.
+            warnings.warn(
+                f'host {self.host_id}: serve loop did not exit within '
+                f'30s of stop() — skipping the router drain (the loop '
+                f'thread still owns the router)', RuntimeWarning)
+            return
+        if drain:
+            self.router.drain()
+
+    # ------------------------------------------------------------------ #
+    # the serve loop: the ONLY thread that touches the router
+    # ------------------------------------------------------------------ #
+    def _loop(self):
+        watched: List[Tuple[PendingResult, _Call]] = []
+        while not (self._stop.is_set() and self._inbox.empty()
+                   and not watched):
+            try:
+                call = self._inbox.get(timeout=0.002)
+            except queue.Empty:
+                call = None
+            if call is not None:
+                try:
+                    self._handle_call(call, watched)
+                except Exception as e:
+                    # NO handler exception may kill this thread: a dead
+                    # loop wedges every future RPC into host_timeout
+                    # and the host can never rejoin the fleet. Answer
+                    # structurally — an alive host saying "no" is the
+                    # whole transport contract.
+                    call.respond(dict(ok=False, error=dict(
+                        code='internal',
+                        message=f'{call.method!r} handler raised '
+                                f'{type(e).__name__}: {e}')))
+            try:
+                self.router.pump()
+            except Exception as e:
+                # a raising sync runner without failure hooks lands
+                # here (its requests already resolved done-with-error
+                # inside dispatch_batch) — but a PERSISTENT pump bug
+                # would too, re-raising every iteration. Warn once per
+                # distinct error so a wedged host leaves evidence
+                # instead of silence.
+                key = f'{type(e).__name__}: {e}'
+                if key not in self._pump_errors_seen:
+                    self._pump_errors_seen.add(key)
+                    warnings.warn(
+                        f'host {self.host_id}: router.pump raised '
+                        f'{key} (warned once; the serve loop '
+                        f'continues)', RuntimeWarning)
+            if watched:
+                done = [(p, c) for p, c in watched if p.done]
+                if done:
+                    watched[:] = [(p, c) for p, c in watched if not p.done]
+                    for p, c in done:
+                        c.respond(self._infer_response(p))
+            try:
+                self._maybe_flush()
+            except Exception as e:   # a failing telemetry bank (disk
+                #                      full, rotated file) must not
+                #                      take the serve loop with it
+                warnings.warn(f'host {self.host_id}: telemetry flush '
+                              f'failed: {type(e).__name__}: {e}',
+                              RuntimeWarning)
+
+    def _handle_call(self, call: _Call, watched: list):
+        method, payload = call.method, call.payload
+        now = self.clock()
+        if method == 'stats':
+            call.respond(dict(ok=True, stats=self._stats_body(now)))
+        elif method == 'drain':
+            call.respond(dict(ok=True, batches=self.router.drain()))
+        elif method == 'swap':
+            try:
+                events = self.router.swap_from_checkpoint(
+                    payload['directory'], payload.get('step'))
+                if self.on_swap is not None:
+                    self.on_swap(payload, events)
+                call.respond(dict(ok=True, events=events,
+                                  tag=events[0]['tag'] if events else None))
+            except Exception as e:
+                call.respond(dict(ok=False, error=dict(
+                    code='internal',
+                    message=f'swap failed: {type(e).__name__}: {e}')))
+        elif method == 'infer':
+            try:
+                tokens = np.asarray(payload['tokens'])
+                coords = np.asarray(payload['coords'],
+                                    np.float32).reshape(-1, 3)
+                pending = self.router.submit(
+                    tokens, coords, timeout_s=payload.get('timeout_s'))
+            except RequestRejected as e:
+                call.respond(dict(ok=False, error=dict(
+                    code=e.code, message=str(e), detail=e.detail)))
+                return
+            except Exception as e:
+                call.respond(dict(ok=False, error=dict(
+                    code='internal',
+                    message=f'{type(e).__name__}: {e}')))
+                return
+            watched.append((pending, call))
+
+    def _infer_response(self, p: PendingResult) -> dict:
+        if p.ok:
+            return dict(ok=True,
+                        result=np.asarray(p.result).tolist(),
+                        latency_ms=round((p.latency_s or 0.0) * 1e3, 3))
+        err = p.error
+        if isinstance(err, (RequestFailed, RequestRejected)):
+            return dict(ok=False, error=dict(
+                code=err.code, message=str(err), detail=err.detail))
+        return dict(ok=False, error=dict(
+            code='internal', message=f'{type(err).__name__}: {err}'))
+
+    def _stats_body(self, now: float) -> dict:
+        """The per-host routing signal, scraped off the surfaces that
+        already exist (router counters, the shared PhaseTimer's
+        cumulative per-bucket p99, RouterTelemetry's compile verdict) —
+        the fleet routes on THESE, so they must be the same numbers the
+        serve records carry."""
+        r = self.router
+        cum = r.workers[0].engine.timer.cumulative_summary()
+        p99 = {phase[len('bucket_'):]: st.get('p99_ms')
+               for phase, st in cum.items() if phase.startswith('bucket_')}
+        post_warmup = None
+        if self.telemetry is not None:
+            self.telemetry._check_runtime()     # fold in compile deltas
+            post_warmup = self.telemetry.post_warmup_compiles
+        return dict(
+            host=self.host_id, t=round(now, 4),
+            buckets=list(r.buckets),
+            queue_depth=r.queue_depth,
+            depth_by_bucket={str(b): d
+                             for b, d in r.depth_by_bucket.items()},
+            p99_ms_by_bucket=p99,
+            precision_mixes=sorted({
+                getattr(w.engine, 'precision_name', 'fp32')
+                for w in r.workers}),
+            served=sum(w.served_rows for w in r.workers),
+            batches=r.batches_dispatched,
+            retries=r.retries,
+            request_failures=r.request_failures,
+            timeouts=r.timeouts,
+            deadline_sheds=r.deadline_sheds,
+            swaps=len(r.swap_events),
+            health=r.health.snapshot(),
+            post_warmup_compiles=post_warmup,
+        )
+
+    def _maybe_flush(self):
+        if self.telemetry is None:
+            return
+        batches = self.router.batches_dispatched
+        if batches - self._flushed_at_batches >= self.flush_every_batches:
+            self._flushed_at_batches = batches
+            self.telemetry.flush()
+
+
+class _HostHandle:
+    """Fleet-side view of one host: its transport plus the scraped
+    signal cache and in-flight accounting (mutated under the fleet's
+    lock)."""
+
+    def __init__(self, host_id: int, transport):
+        self.id = int(host_id)
+        self.transport = transport
+        self.outstanding = 0            # fleet-side in-flight RPCs
+        self.stats: dict = {}           # last successful scrape
+        self.last_ok_at: Optional[float] = None
+        self.last_attempt_at: Optional[float] = None
+        self.last_stale_mark: Optional[float] = None
+        self.last_error: Optional[str] = None
+
+
+class FleetRouter:
+    """Health-aware cross-host placement + retry + canaried rollout.
+
+        transports = {0: SocketTransport(...), 1: ..., 2: ...}
+        with FleetRouter(transports, max_retries=2,
+                         default_timeout_s=30.0) as fleet:
+            pending = fleet.submit(tokens, coords)   # async: a pool
+            fleet.pump()          # heartbeats, staleness, probes
+            event, probes = fleet.rollout(new_ref, old_ref, traffic)
+            fleet.drain()         # barrier: every submit resolved
+
+    `submit` returns immediately (a worker-pool thread walks the
+    dispatch: pick host -> RPC -> redispatch-on-failure -> resolve);
+    `drain()` barriers the pool. Every submit ends answered or with a
+    structured error through `_fail_request` — the fleet-wide zero-lost
+    contract (`host_exclusion = False` is the chaos smoke's weaken
+    hook: quarantine and failed-host exclusion stop steering placement,
+    so a dead host keeps eating traffic and the gate must fire).
+    """
+
+    def __init__(self, transports, *,
+                 health: Optional[HealthConfig] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 max_retries: int = 2,
+                 default_timeout_s: Optional[float] = None,
+                 heartbeat_every_s: float = 0.5,
+                 heartbeat_timeout_s: float = 2.0,
+                 stale_after_s: float = 5.0,
+                 concurrency: int = 8):
+        if isinstance(transports, dict):
+            items = sorted(transports.items())
+        else:
+            items = list(enumerate(transports))
+        assert items, 'a fleet needs at least one host'
+        self.hosts: Dict[int, _HostHandle] = {
+            int(k): _HostHandle(k, t) for k, t in items}
+        self.health = HealthMonitor(list(self.hosts),
+                                    config=health, clock=clock)
+        self.clock = clock
+        self.max_retries = int(max_retries)
+        assert self.max_retries >= 0
+        self.default_timeout_s = default_timeout_s
+        self.heartbeat_every_s = float(heartbeat_every_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.stale_after_s = float(stale_after_s)
+        self.host_exclusion = True      # the chaos weaken hook
+        self.buckets: Optional[tuple] = None   # learned from scrapes
+        self._lock = threading.Lock()
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(2, int(concurrency)),
+            thread_name_prefix='fleet')
+        self._futures: List[Future] = []
+        self._next_id = 0
+        # fleet-wide counters (under _lock; the fleet record reads them)
+        self.submitted = 0
+        self.answered = 0
+        self.cross_host_retries = 0
+        self.request_failures = 0
+        self.timeouts = 0
+        self.heartbeats_ok = 0
+        self.heartbeats_failed = 0
+        self.stale_marks = 0
+        self.rollout_events: List[dict] = []
+        self.rollbacks = 0
+        self.rollouts = 0
+
+    # ------------------------------------------------------------------ #
+    @property
+    def queue_depth(self) -> int:
+        """Fleet-side in-flight RPCs + the hosts' scraped queue depths
+        (stale by at most a heartbeat interval)."""
+        with self._lock:
+            inflight = sum(h.outstanding for h in self.hosts.values())
+            scraped = sum(h.stats.get('queue_depth', 0)
+                          for h in self.hosts.values())
+        return inflight + scraped
+
+    def retry_after_hint(self, queue_depth: int) -> float:
+        """Backoff hint for structured failures (the satellite
+        contract: RequestFailed carries the same machine-readable
+        `retry_after_s` an overload RequestRejected does). Per-request
+        drain estimate from the scraped per-bucket p99s; 50 ms/request
+        before any host reported latency."""
+        per_row_s = 0.05
+        with self._lock:
+            p99s = [v for h in self.hosts.values()
+                    for v in (h.stats.get('p99_ms_by_bucket') or {}).values()
+                    if isinstance(v, (int, float))]
+        if p99s:
+            per_row_s = (sum(p99s) / len(p99s)) / 1e3
+        return max(1, int(queue_depth)) * per_row_s
+
+    def _fail_request(self, pending: PendingResult,
+                      error: Exception) -> None:
+        """THE terminal structured choke point, fleet tier — the same
+        zero-lost contract `Router._fail_request` carries. Stamps the
+        `retry_after_s` backoff hint when the error lacks one."""
+        if isinstance(error, RequestFailed) and \
+                'retry_after_s' not in error.detail:
+            error.detail['retry_after_s'] = round(
+                max(0.0, self.retry_after_hint(self.queue_depth)), 4)
+        pending.error = error
+        pending.done = True
+        pending.completed_at = self.clock()
+        with self._lock:
+            self.request_failures += 1
+
+    # ------------------------------------------------------------------ #
+    # placement
+    # ------------------------------------------------------------------ #
+    def _score(self, h: _HostHandle):
+        # with `host_exclusion` nulled (the weaken arm) placement is
+        # load-only: health must not steer traffic away from a sick
+        # host, or the weakened gate would be protected by the very
+        # mechanism it claims to have disabled
+        rank = 0
+        if self.host_exclusion and self.health.state(h.id) != 'healthy':
+            rank = 1
+        depth = h.outstanding + h.stats.get('queue_depth', 0)
+        p99s = [v for v in (h.stats.get('p99_ms_by_bucket') or {}).values()
+                if isinstance(v, (int, float))]
+        return (depth, rank, max(p99s) if p99s else 0.0, h.id)
+
+    def _pick_host(self, exclude: Optional[int] = None) -> _HostHandle:
+        """Least-loaded over (fleet in-flight + scraped depth), healthy
+        before degraded, scraped p99 tie-break. Quarantined hosts and
+        `exclude` (the host a retry just failed on) leave the pool —
+        unless `host_exclusion` was nulled (the weaken arm), in which
+        case placement is load-only and the chaos gate must catch the
+        consequences. All-quarantined degrades to best-effort over
+        everything (serving through a sick host beats black-holing)."""
+        hosts = list(self.hosts.values())
+        pool = hosts
+        if self.host_exclusion:
+            pool = [h for h in hosts
+                    if h.id != exclude
+                    and self.health.state(h.id) != QUARANTINED]
+            if not pool:
+                pool = [h for h in hosts if h.id != exclude] or hosts
+        return min(pool, key=self._score)
+
+    # ------------------------------------------------------------------ #
+    # submission + dispatch
+    # ------------------------------------------------------------------ #
+    def submit(self, tokens, coords,
+               timeout_s: Optional[float] = None,
+               pin_host: Optional[int] = None) -> PendingResult:
+        """Admit one request; a pool thread dispatches it (cross-host
+        retries included) and resolves the returned PendingResult.
+        Oversize requests reject at the door once any host has reported
+        its buckets (before that, the host's own rejection resolves the
+        pending structurally — either way, never silence).
+
+        `pin_host` pins the dispatch to ONE host, single-attempt (the
+        rollout's canary probes ride this: a redispatch to a healthy
+        sibling would mask exactly the failure the canary gate exists
+        to observe)."""
+        tokens = np.asarray(tokens)
+        coords = np.asarray(coords, np.float32).reshape(-1, 3)
+        length = len(tokens)
+        bucket = -1
+        if self.buckets:
+            bucket = fit_bucket(self.buckets, length)
+            if bucket is None:
+                raise oversize_error(length, self.buckets[-1])
+        submitted_at = self.clock()
+        timeout_s = (timeout_s if timeout_s is not None
+                     else self.default_timeout_s)
+        deadline = (submitted_at + float(timeout_s)
+                    if timeout_s is not None else None)
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self.submitted += 1
+        pending = PendingResult(rid, length, bucket, submitted_at,
+                                deadline=deadline)
+        self._track(self._executor.submit(
+            self._dispatch, pending, tokens, coords, pin_host))
+        return pending
+
+    def _track(self, future: Future):
+        with self._lock:
+            # prune cleanly-finished futures so the list stays bounded
+            self._futures = [f for f in self._futures if not f.done()]
+            self._futures.append(future)
+
+    def _dispatch(self, pending: PendingResult, tokens, coords,
+                  pin_host: Optional[int] = None):
+        """Worker-pool body: pick -> RPC -> redispatch or resolve.
+        NEVER raises — every exit resolves the pending (the zero-lost
+        contract is this function terminating structurally)."""
+        exclude = None
+        last_err: Optional[Exception] = None
+        try:
+            while True:
+                now = self.clock()
+                if pending.expired(now):
+                    timeout_s = ((pending.deadline - pending.submitted_at)
+                                 if pending.deadline is not None else 0.0)
+                    with self._lock:
+                        self.timeouts += 1
+                    self._fail_request(pending, deadline_error(
+                        now - pending.submitted_at, timeout_s,
+                        attempts=pending.attempts))
+                    return
+                host = (self.hosts[pin_host] if pin_host is not None
+                        else self._pick_host(exclude=exclude))
+                outcome, err = self._call_infer(host, pending,
+                                                tokens, coords)
+                if outcome in ('answered', 'resolved'):
+                    return
+                last_err = err
+                pending.attempts += 1
+                if pin_host is not None:
+                    # pinned probe traffic (canary gating): one host,
+                    # one attempt — a redispatch to a healthy sibling
+                    # would MASK exactly the failure the gate exists
+                    # to observe
+                    self._fail_request(pending, retries_exhausted_error(
+                        pending.attempts, last_err))
+                    return
+                if self.host_exclusion:
+                    exclude = host.id
+                if pending.attempts > self.max_retries:
+                    self._fail_request(pending, retries_exhausted_error(
+                        pending.attempts, last_err))
+                    return
+                with self._lock:
+                    self.cross_host_retries += 1
+        except Exception as e:   # defense in depth: a bug here must
+            #                      still resolve the request, not lose it
+            if not pending.done:
+                self._fail_request(pending, retries_exhausted_error(
+                    pending.attempts + 1, e))
+
+    def _call_infer(self, host: _HostHandle, pending: PendingResult,
+                    tokens, coords):
+        """One RPC attempt -> ('answered' | 'resolved' | 'failed', err).
+        'failed' means a HOST failure (transport error or a host-level
+        error code): breaker fed, caller redispatches. 'resolved' means
+        the request got a structured verdict (deadline / reject) that
+        redispatching cannot improve."""
+        now = self.clock()
+        payload = dict(tokens=np.asarray(tokens).tolist(),
+                       coords=np.asarray(coords).tolist())
+        rpc_timeout = None
+        if pending.deadline is not None:
+            remaining = max(0.0, pending.deadline - now)
+            payload['timeout_s'] = round(remaining, 4)
+            rpc_timeout = remaining + 5.0
+        with self._lock:
+            host.outstanding += 1
+        try:
+            res = host.transport.call('infer', payload,
+                                      timeout_s=rpc_timeout)
+        except TransportError as e:
+            host.last_error = str(e)
+            self.health.record_failure(host.id, e)
+            return 'failed', e
+        finally:
+            with self._lock:
+                host.outstanding -= 1
+        if res.get('ok'):
+            self.health.record_success(host.id)
+            pending.result = np.asarray(res['result'], np.float32)
+            pending.done = True
+            pending.completed_at = self.clock()
+            with self._lock:
+                self.answered += 1
+            return 'answered', None
+        err = (res.get('error') or {})
+        code = err.get('code')
+        message = err.get('message', 'host returned no message')
+        detail = dict(err.get('detail') or {}, host=host.id)
+        if code in _HOST_FAILURE_CODES or code is None:
+            e = RuntimeError(f'host {host.id}: {code}: {message}')
+            host.last_error = str(e)
+            self.health.record_failure(host.id, e)
+            return 'failed', e
+        if code == 'deadline':
+            with self._lock:
+                self.timeouts += 1
+            self._fail_request(pending,
+                               RequestFailed(code, message, **detail))
+        elif code in ('oversize', 'overloaded'):
+            self._fail_request(pending,
+                               RequestRejected(code, message, **detail))
+        else:
+            self._fail_request(pending,
+                               RequestFailed(code, message, **detail))
+        return 'resolved', None
+
+    # ------------------------------------------------------------------ #
+    # heartbeats, staleness, probes
+    # ------------------------------------------------------------------ #
+    def pump(self, now: Optional[float] = None) -> None:
+        """The fleet heartbeat: scrape due hosts (routing signals),
+        mark stale ones as failures, and issue half-open `ping` probes
+        to quarantined hosts whose backoff elapsed (claimed atomically
+        via `try_begin_probe`, so concurrent pumps never double-book).
+        All RPCs run on the pool — pump never blocks the serve loop."""
+        now = self.clock() if now is None else now
+        for h in self.hosts.values():
+            if self.health.state(h.id) == QUARANTINED:
+                if self.health.try_begin_probe(h.id, now):
+                    self._track(self._executor.submit(self._probe, h))
+                continue
+            if h.last_attempt_at is None or \
+                    now - h.last_attempt_at >= self.heartbeat_every_s:
+                h.last_attempt_at = now
+                self._track(self._executor.submit(self._heartbeat, h))
+            anchor = h.last_ok_at
+            if anchor is not None and now - anchor >= self.stale_after_s \
+                    and (h.last_stale_mark is None
+                         or now - h.last_stale_mark >= self.stale_after_s):
+                # the link isn't refusing, it's SILENT: staleness is a
+                # failure signal of its own (a partitioned host must
+                # leave rotation even if no RPC happens to fail)
+                h.last_stale_mark = now
+                with self._lock:
+                    self.stale_marks += 1
+                self.health.record_failure(h.id, RuntimeError(
+                    f'heartbeat stale: host {h.id} last answered '
+                    f'{now - anchor:.1f}s ago '
+                    f'(stale_after_s={self.stale_after_s})'))
+
+    def _heartbeat(self, h: _HostHandle):
+        """Scrape one host's stats. Successes refresh the routing
+        signal but do NOT feed the breaker (asymmetric by design: a
+        host that answers pings while failing dispatches must not have
+        its breaker reset by the pings); failures feed it.
+
+        A `stats` timeout behind a long synchronous dispatch counts as
+        a failure ON PURPOSE — a host too busy to report within
+        `heartbeat_timeout_s` is degraded service, and steering load
+        away is the right response. Recovery is cheap: `ping` probes
+        answer off the serve loop (process liveness), so a merely-busy
+        host closes its breaker the moment its backoff elapses. Size
+        `heartbeat_timeout_s` above the worst healthy batch latency."""
+        try:
+            res = h.transport.call('stats',
+                                   timeout_s=self.heartbeat_timeout_s)
+        except TransportError as e:
+            h.last_error = str(e)
+            with self._lock:
+                self.heartbeats_failed += 1
+            self.health.record_failure(h.id, e)
+            return
+        if res.get('ok'):
+            h.stats = res.get('stats') or {}
+            h.last_ok_at = self.clock()
+            h.last_stale_mark = None
+            with self._lock:
+                self.heartbeats_ok += 1
+                if self.buckets is None and h.stats.get('buckets'):
+                    self.buckets = tuple(int(b)
+                                         for b in h.stats['buckets'])
+        else:
+            with self._lock:
+                self.heartbeats_failed += 1
+            self.health.record_failure(h.id, RuntimeError(
+                f'stats RPC returned error: {res.get("error")}'))
+
+    def _probe(self, h: _HostHandle):
+        """The half-open probe (claimed before submission): one `ping`.
+        Success closes the breaker back to degraded — the host rejoins
+        rotation and dispatch successes walk it to healthy; failure
+        doubles the backoff. A restarted process on the same port
+        recovers through exactly this path."""
+        try:
+            res = h.transport.call('ping',
+                                   timeout_s=self.heartbeat_timeout_s)
+        except TransportError as e:
+            h.last_error = str(e)
+            self.health.record_failure(h.id, e)
+            return
+        if res.get('ok'):
+            self.health.record_success(h.id)
+            h.last_ok_at = self.clock()
+            h.last_stale_mark = None
+        else:
+            self.health.record_failure(h.id, RuntimeError(
+                f'probe ping returned error: {res.get("error")}'))
+
+    # ------------------------------------------------------------------ #
+    # canaried rollout
+    # ------------------------------------------------------------------ #
+    def _swap(self, h: _HostHandle, ref: dict) -> str:
+        res = h.transport.call('swap', dict(ref),
+                               timeout_s=self.heartbeat_timeout_s + 30.0)
+        if not res.get('ok'):
+            raise TransportError(
+                f'host {h.id} swap to {ref} failed: {res.get("error")}')
+        return res.get('tag') or '?'
+
+    def rollout(self, new_ref: dict, rollback_ref: dict,
+                canary_traffic: Sequence[tuple], *,
+                canary: Optional[int] = None,
+                latency_budget_ms: Optional[float] = None,
+                timeout_s: Optional[float] = None):
+        """Fleet-wide rolling weight rollout over the hosts' drain/swap
+        contract, gated by a CANARY:
+
+          1. swap ONE host (`canary`, default: the best-ranked live
+             host) to `new_ref` ({'directory': ..., 'step': ...} — the
+             host's `swap_from_checkpoint` handles torn-latest
+             fallback and tags the step actually restored);
+          2. drive `canary_traffic` ([(tokens, coords), ...]) PINNED to
+             the canary — single-attempt, failures resolve structurally
+             on the canary instead of being masked by redispatch;
+          3. gate on the canary's serve evidence: every probe answered,
+             zero lost, max latency within `latency_budget_ms` (when
+             given), and zero NEW host-side structured failures across
+             the swap (scraped stats delta);
+          4. gate passed -> roll every other host; gate failed -> AUTO
+             ROLL-BACK the canary to `rollback_ref` and leave the rest
+             of the fleet untouched.
+
+        Returns `(event, probes)`: the JSON-safe rollout event (also
+        appended to `rollout_events` — the fleet record's evidence) and
+        the probe PendingResults (callers fold them into their
+        zero-lost accounting)."""
+        pool = [h for h in self.hosts.values()
+                if self.health.state(h.id) != QUARANTINED]
+        assert pool, 'every host is quarantined — nothing to canary'
+        canary_host = (self.hosts[int(canary)] if canary is not None
+                       else min(pool, key=self._score))
+        pre = self._scrape_sync(canary_host)
+        event = dict(t=round(self.clock(), 3), canary=canary_host.id,
+                     new=dict(new_ref))
+        try:
+            event['canary_tag'] = self._swap(canary_host, new_ref)
+        except TransportError as e:
+            self.health.record_failure(canary_host.id, e)
+            event.update(passed=False, rolled_back=False,
+                         aborted=f'canary swap failed: {e}')
+            with self._lock:
+                self.rollout_events.append(event)
+            return event, []
+        # the probes ride the SAME admission path as every request
+        # (oversize gate included), just pinned single-attempt
+        probes = [self.submit(tokens, coords, timeout_s=timeout_s,
+                              pin_host=canary_host.id)
+                  for tokens, coords in canary_traffic]
+        self._wait_for(probes)
+        post = self._scrape_sync(canary_host)
+        answered = sum(1 for p in probes if p.ok)
+        lost = sum(1 for p in probes if not p.done)
+        lat = [p.latency_s * 1e3 for p in probes
+               if p.ok and p.latency_s is not None]
+        failures_delta = None
+        if pre is not None and post is not None:
+            failures_delta = (post.get('request_failures', 0)
+                              - pre.get('request_failures', 0))
+        gate = dict(requests=len(probes), answered=answered,
+                    failures=len(probes) - answered, lost=lost,
+                    max_latency_ms=round(max(lat), 3) if lat else None,
+                    latency_budget_ms=latency_budget_ms,
+                    host_request_failures_delta=failures_delta)
+        passed = (len(probes) > 0 and answered == len(probes)
+                  and lost == 0
+                  and (failures_delta in (None, 0))
+                  and (latency_budget_ms is None
+                       or (lat and max(lat) <= latency_budget_ms)))
+        event.update(gate=gate, passed=bool(passed))
+        if passed:
+            rolled = []
+            for h in sorted(self.hosts.values(), key=lambda h: h.id):
+                if h.id == canary_host.id:
+                    continue
+                try:
+                    rolled.append(dict(host=h.id,
+                                       tag=self._swap(h, new_ref)))
+                except TransportError as e:
+                    self.health.record_failure(h.id, e)
+                    rolled.append(dict(host=h.id, error=str(e)))
+            event.update(rolled=rolled, rolled_back=False)
+            with self._lock:
+                self.rollouts += 1
+        else:
+            rb_ok = True
+            try:
+                rb_tag = self._swap(canary_host, rollback_ref)
+            except TransportError as e:
+                # the canary is STRANDED on the bad weights — that must
+                # never read as an observed rollback (the gated
+                # `rollbacks` counter only counts swaps that landed)
+                self.health.record_failure(canary_host.id, e)
+                rb_ok = False
+                rb_tag = f'ROLLBACK FAILED: {e}'
+            event.update(rolled=[], rolled_back=rb_ok,
+                         rollback=dict(ref=dict(rollback_ref),
+                                       tag=rb_tag, ok=rb_ok))
+            with self._lock:
+                if rb_ok:
+                    self.rollbacks += 1
+        with self._lock:
+            self.rollout_events.append(event)
+        return event, probes
+
+    def _scrape_sync(self, h: _HostHandle) -> Optional[dict]:
+        try:
+            res = h.transport.call('stats',
+                                   timeout_s=self.heartbeat_timeout_s)
+        except TransportError:
+            return None
+        if res.get('ok'):
+            h.stats = res.get('stats') or {}
+            h.last_ok_at = self.clock()
+            return h.stats
+        return None
+
+    def _wait_for(self, probes: Sequence[PendingResult],
+                  timeout_s: float = 120.0):
+        t0 = time.monotonic()
+        while any(not p.done for p in probes):
+            if time.monotonic() - t0 > timeout_s:
+                break
+            time.sleep(0.005)
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+    def drain(self) -> None:
+        """Barrier: every dispatch/probe/heartbeat the fleet started
+        has finished (each resolves its own request structurally, so
+        after drain() the caller's pendings are all done-or-failed)."""
+        while True:
+            with self._lock:
+                futures, self._futures = self._futures, []
+            if not futures:
+                return
+            for f in futures:
+                f.exception()   # _dispatch resolves internally; a bug
+                #                 surfacing here must not wedge drain
+
+    def close(self) -> None:
+        self.drain()
+        self._executor.shutdown(wait=True)
+
+    def __enter__(self) -> 'FleetRouter':
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    # ------------------------------------------------------------------ #
+    # the `fleet` record
+    # ------------------------------------------------------------------ #
+    def record_body(self, pending: Optional[Sequence[PendingResult]] = None,
+                    label: str = 'fleet') -> dict:
+        """Assemble the schema'd `fleet` record body (the caller logs
+        it: `logger.log_record('fleet', **body)`): per-host breaker
+        snapshots + last scraped signals, the merged host-transition
+        log, cross-host retry / failure / heartbeat counters, rollout
+        and rollback evidence, and the load-bearing fleet-wide
+        `lost_requests` over the caller's submitted `pending` list
+        (None limits lost accounting to what the fleet can see, i.e.
+        0 — pass the real list)."""
+        pending = list(pending or [])
+        hsnap = self.health.snapshot()
+        hosts = {}
+        for hid, h in sorted(self.hosts.items()):
+            entry = dict(hsnap[str(hid)])
+            entry['outstanding'] = h.outstanding
+            if h.stats:
+                entry['stats'] = {
+                    k: h.stats.get(k)
+                    for k in ('queue_depth', 'served', 'batches',
+                              'request_failures', 'retries', 'timeouts',
+                              'precision_mixes', 'swaps',
+                              'post_warmup_compiles')
+                    if k in h.stats}
+            if h.last_error:
+                entry['last_error'] = h.last_error
+            hosts[str(hid)] = entry
+        transitions = [dict(e, host=e['replica'])
+                       for e in self.health.transitions]
+        with self._lock:
+            body = dict(
+                label=label,
+                hosts=hosts,
+                host_transitions=transitions,
+                recoveries=self.health.recoveries,
+                cross_host_retries=self.cross_host_retries,
+                request_failures=self.request_failures,
+                timeouts=self.timeouts,
+                heartbeats=dict(ok=self.heartbeats_ok,
+                                failed=self.heartbeats_failed,
+                                stale_marks=self.stale_marks),
+                rollouts=dict(count=len(self.rollout_events),
+                              completed=self.rollouts,
+                              events=list(self.rollout_events)),
+                rollbacks=self.rollbacks,
+                submitted=self.submitted,
+                answered=self.answered,
+                resolved=sum(1 for p in pending if p.done),
+                structured_failures=sum(
+                    1 for p in pending
+                    if p.done and p.error is not None),
+                lost_requests=sum(1 for p in pending if not p.done),
+            )
+        return body

@@ -29,6 +29,15 @@ router the missing signal:
     and the `recoveries` counter (`make chaos-smoke` gates on >= 1
     quarantine -> recovery transition being observed).
 
+The member ids are OPAQUE ints: PR 12 drives one monitor per router
+with replica ids; the cross-host tier (`serving.fleet.FleetRouter`)
+drives a second monitor one level up with HOST ids — same breaker state
+machine, same transition evidence, outcomes fed by RPC results and
+heartbeat staleness instead of dispatch results. Concurrent callers
+must claim probes through `try_begin_probe` (check + begin under ONE
+lock acquisition) — a separate probe_due()/begin_probe() pair is a
+race that double-books the half-open slot.
+
 Every transition is recorded as a JSON-safe event so the chaos harness
 and telemetry stream can prove the breaker actually cycled, not just
 that the code exists.
@@ -226,6 +235,22 @@ class HealthMonitor:
     def begin_probe(self, replica_id: int):
         with self._lock:
             self._replicas[int(replica_id)].begin_probe()
+
+    def try_begin_probe(self, replica_id: int,
+                        now: Optional[float] = None) -> bool:
+        """Atomically claim the half-open probe slot: probe_due check
+        AND begin_probe under one lock acquisition, so N concurrent
+        callers (async dispatch hooks, the fleet's heartbeat executor)
+        can never double-book a probe — at most one returns True per
+        breaker opening. Prefer this over the probe_due()/begin_probe()
+        pair whenever more than one thread routes."""
+        with self._lock:
+            r = self._replicas[int(replica_id)]
+            now = self.clock() if now is None else now
+            if not r.probe_due(now):
+                return False
+            r.begin_probe(now)
+            return True
 
     @property
     def transitions(self) -> List[dict]:

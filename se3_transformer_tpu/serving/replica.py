@@ -105,6 +105,11 @@ class ContinuousBatcher:
         # failed batch's requests for retry-with-redispatch
         self.on_success: Optional[Callable[[int], None]] = None
         self.on_failure: Optional[Callable] = None
+        # retry_hint(queue_depth) -> seconds: when wired (the Router
+        # points it at its retry_after_hint), deadline resolutions
+        # carry the same machine-readable retry_after_s backoff hint
+        # overload sheds do — clients back off uniformly
+        self.retry_hint: Optional[Callable[[int], float]] = None
         # per-request deadline accounting: requests resolved with a
         # structured RequestFailed('deadline') — shed at dispatch time
         # (deadline_sheds) or expired while waiting in an open slot
@@ -130,6 +135,12 @@ class ContinuousBatcher:
     def depth(self) -> int:
         """Requests sitting in open slots (not yet dispatched)."""
         return sum(len(s) for s in self._slots.values())
+
+    @property
+    def depth_by_bucket(self) -> Dict[int, int]:
+        """Open-slot depth per bucket (a routing signal: the fleet tier
+        scrapes it off the host's stats RPC)."""
+        return {s.bucket: len(s) for s in self._slots.values() if len(s)}
 
     @property
     def inflight(self) -> int:
@@ -209,11 +220,19 @@ class ContinuousBatcher:
         publish them to `completed` (the telemetry latency feed sees
         sheds too)."""
         now = self.clock() if now is None else now
+        hint = None
+        if self.retry_hint is not None:
+            try:
+                hint = max(0.0, float(self.retry_hint(self.depth)))
+            except Exception:
+                hint = None     # a broken estimator must not turn a
+                #                 structured timeout into a crash
         for p in expired:
             timeout_s = ((p.deadline - p.submitted_at)
                          if p.deadline is not None else 0.0)
             p.error = deadline_error(now - p.submitted_at, timeout_s,
-                                     attempts=p.attempts)
+                                     attempts=p.attempts,
+                                     retry_after_s=hint)
             p.done = True
             p.completed_at = now
             self.timeouts += 1

@@ -128,6 +128,10 @@ class Router:
         for w in self.workers:
             w.batcher.on_success = self._success_hook(w.id)
             w.batcher.on_failure = self._failure_hook(w.id)
+            # deadline resolutions inside the batcher carry the same
+            # retry_after_s hint _fail_request stamps — terminal
+            # failures back clients off uniformly wherever they resolve
+            w.batcher.retry_hint = self.retry_after_hint
         if admission is not None and admission.retry_hint is None:
             admission.retry_hint = self.retry_after_hint
 
@@ -137,6 +141,16 @@ class Router:
         with self._retry_lock:
             retrying = len(self._retry_queue)
         return sum(w.outstanding for w in self.workers) + retrying
+
+    @property
+    def depth_by_bucket(self) -> dict:
+        """Open-slot depth per bucket across the replicas — one of the
+        per-host routing signals the cross-host tier scrapes."""
+        depths = {b: 0 for b in self.buckets}
+        for w in self.workers:
+            for b, n in w.batcher.depth_by_bucket.items():
+                depths[b] = depths.get(b, 0) + n
+        return depths
 
     @property
     def continuous_admissions(self) -> int:
@@ -205,7 +219,17 @@ class Router:
                       error: RequestFailed) -> None:
         """Terminal structured resolution — the one choke point the
         zero-lost-requests contract rides (the chaos harness's weakened
-        arm overrides exactly this to prove the gate fires)."""
+        arm overrides exactly this to prove the gate fires).
+
+        Every terminal failure leaves carrying the same machine-readable
+        `retry_after_s` hint overload sheds already carry (queue depth x
+        per-request drain estimate), so fleet-level redispatch and
+        external clients back off uniformly instead of hot-looping a
+        struggling router."""
+        if isinstance(error, RequestFailed) and \
+                'retry_after_s' not in error.detail:
+            error.detail['retry_after_s'] = round(
+                max(0.0, self.retry_after_hint(self.queue_depth)), 4)
         pending.error = error
         pending.done = True
         pending.completed_at = self.clock()
@@ -266,8 +290,9 @@ class Router:
                      'one replica out at a time, so this is a bug'
         now = self.clock()
         for w in live:
-            if w.id != exclude and self.health.probe_due(w.id, now):
-                self.health.begin_probe(w.id)
+            # atomic claim: check-and-begin under the monitor's lock, so
+            # a concurrent picker can never double-book the half-open slot
+            if w.id != exclude and self.health.try_begin_probe(w.id, now):
                 return w
 
         def rank(w):

@@ -1,0 +1,256 @@
+"""Minimal pluggable RPC transport for the cross-host serving tier.
+
+The fleet front-end (`serving.fleet.FleetRouter`) speaks to per-host
+`Router`s through exactly one verb:
+
+    response = transport.call(method, payload, timeout_s=...)
+
+where `payload` and `response` are JSON-safe dicts and EVERY failure of
+the link itself — connection refused, reset mid-read, timeout, injected
+partition — surfaces as `TransportError`. That one exception class is
+the fleet's host-failure signal: the host breaker records it, the
+request redispatches onto a sibling host. Application-level failures
+(an oversize reject, a deadline, a spent retry budget INSIDE the host)
+ride the response envelope (`{'ok': False, 'error': {...}}`) and are
+NOT transport errors — a host that answers "no" is alive.
+
+Two implementations, one contract (`tests/test_fleet.py` pins both):
+
+  * `LocalTransport` — in-process: calls the `HostServer.handle` of the
+    wrapped host directly. The unit-test and single-process arm — the
+    fleet logic is identical, only the wire is gone.
+  * `SocketTransport` / `serve_socket` — newline-delimited JSON over a
+    TCP socket, one request per connection (a fleet front-end's call
+    rate is batches, not packets — reconnect-per-call keeps a host
+    restart transparent: the next call simply connects to the new
+    process on the same port). `serve_socket` runs the accept loop for
+    a `HostServer` on a daemon thread; `scripts/serve.py --host` is the
+    process entry point.
+
+Both fire the seeded `faults.FaultInjector` at the `transport` site
+before sending (ctx: method, host), so the fleet-chaos smoke's RPC
+flakiness is deterministic: `latency` plans sleep (a slow link),
+`exception` plans raise (a reset connection — re-raised as
+`TransportError`, the path a real reset walks), and the cooperative
+`drop` kind models a partition (the transport raises `TransportError`
+without ever sending).
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Callable, Optional
+
+from ..faults import InjectedFault
+
+__all__ = ['TransportError', 'LocalTransport', 'SocketTransport',
+           'SocketServer', 'serve_socket']
+
+
+class TransportError(RuntimeError):
+    """The link to a host failed (refused / reset / timeout / injected
+    partition). The fleet treats this as a HOST outcome — breaker
+    failure + cross-host redispatch — never as a request verdict."""
+
+
+def _fire_transport_faults(injector, method: str, host: str) -> None:
+    """Shared injection hook: one site, three deterministic failure
+    modes (latency sleeps in place; exception and drop both surface as
+    TransportError so they walk the exact path a real link failure
+    walks)."""
+    if injector is None:
+        return
+    try:
+        kind = injector.fire('transport', method=method, host=host)
+    except InjectedFault as e:
+        raise TransportError(str(e)) from e
+    if kind == 'drop':
+        raise TransportError(
+            f'injected partition: {method!r} to host {host} dropped '
+            f'(request never sent, no response will come)')
+
+
+class LocalTransport:
+    """In-process transport: the wire-free arm of the contract.
+
+        server = HostServer(router, host_id=0)
+        t = LocalTransport(server, fault_injector=inj)
+        t.call('ping')                     # -> {'ok': True, ...}
+    """
+
+    def __init__(self, server, fault_injector=None,
+                 label: Optional[str] = None):
+        self.server = server
+        self.fault_injector = fault_injector
+        self.label = label if label is not None else \
+            f'local:{getattr(server, "host_id", "?")}'
+
+    def call(self, method: str, payload: Optional[dict] = None,
+             timeout_s: Optional[float] = None) -> dict:
+        _fire_transport_faults(self.fault_injector, method, self.label)
+        try:
+            return self.server.handle(method, payload,
+                                      timeout_s=timeout_s)
+        except Exception as e:  # a crashed handler IS a dead host
+            raise TransportError(
+                f'{self.label}: {method!r} handler raised '
+                f'{type(e).__name__}: {e}') from e
+
+    def __repr__(self):
+        return f'LocalTransport({self.label})'
+
+
+class SocketTransport:
+    """Newline-delimited JSON over TCP, one request per connection.
+
+        t = SocketTransport('127.0.0.1', 9000)
+        t.call('infer', dict(tokens=[...], coords=[...]), timeout_s=5)
+
+    `timeout_s` bounds connect + send + the full response read — the
+    deadline-propagation arm of the fleet contract (a hung host must
+    cost one timeout, not a wedged front-end). Connecting per call
+    makes a host RESTART transparent: the next call reaches whatever
+    process now owns the port.
+    """
+
+    def __init__(self, host: str, port: int, *,
+                 timeout_s: float = 30.0, fault_injector=None,
+                 label: Optional[str] = None):
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = float(timeout_s)
+        self.fault_injector = fault_injector
+        self.label = label if label is not None else f'{host}:{port}'
+
+    def call(self, method: str, payload: Optional[dict] = None,
+             timeout_s: Optional[float] = None) -> dict:
+        _fire_transport_faults(self.fault_injector, method, self.label)
+        timeout = self.timeout_s if timeout_s is None else float(timeout_s)
+        # one ABSOLUTE deadline for connect + send + the full response
+        # read: a per-recv timeout would let a host that trickles one
+        # chunk per interval hold a fleet pool thread indefinitely —
+        # exactly the wedged front-end this bound exists to prevent
+        deadline = time.monotonic() + max(0.001, timeout)
+
+        def remaining() -> float:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise socket.timeout(
+                    f'transport deadline ({timeout:.3f}s) exhausted')
+            return left
+
+        line = json.dumps(dict(method=method,
+                               payload=payload or {})) + '\n'
+        try:
+            with socket.create_connection((self.host, self.port),
+                                          timeout=remaining()) as s:
+                s.settimeout(remaining())
+                s.sendall(line.encode())
+                s.shutdown(socket.SHUT_WR)
+                chunks = []
+                while True:
+                    s.settimeout(remaining())
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        break
+                    chunks.append(chunk)
+        except OSError as e:
+            raise TransportError(
+                f'{self.label}: {method!r} failed on the wire: '
+                f'{type(e).__name__}: {e}') from e
+        raw = b''.join(chunks)
+        if not raw.strip():
+            raise TransportError(
+                f'{self.label}: {method!r} got an empty response '
+                f'(host died mid-call?)')
+        try:
+            return json.loads(raw.decode())
+        except ValueError as e:
+            raise TransportError(
+                f'{self.label}: {method!r} returned undecodable bytes '
+                f'({len(raw)}B): {e}') from e
+
+    def __repr__(self):
+        return f'SocketTransport({self.label})'
+
+
+class SocketServer:
+    """Accept loop exposing a `HostServer` on a TCP port (daemon
+    threads: one acceptor, one per in-flight connection — connections
+    are one-shot, so the per-connection thread count tracks the fleet's
+    in-flight RPC count, which the front-end already bounds)."""
+
+    def __init__(self, handler: Callable, port: int = 0,
+                 host: str = '127.0.0.1'):
+        self.handler = handler
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name=f'rpc-accept:{self.port}',
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        try:
+            self._sock.settimeout(0.2)
+        except OSError:
+            return    # close() won the startup race — nothing to serve
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._serve_one, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_one(self, conn: socket.socket):
+        with conn:
+            try:
+                conn.settimeout(60.0)
+                buf = b''
+                while not buf.endswith(b'\n'):
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                req = json.loads(buf.decode())
+                try:
+                    resp = self.handler(req.get('method'),
+                                        req.get('payload'),
+                                        timeout_s=(req.get('payload') or
+                                                   {}).get('timeout_s'))
+                except Exception as e:  # handler crash -> app error, not
+                    #                     a torn wire: the caller can at
+                    #                     least read what happened
+                    resp = dict(ok=False, error=dict(
+                        code='internal',
+                        message=f'{type(e).__name__}: {e}'))
+                conn.sendall((json.dumps(resp) + '\n').encode())
+            except (OSError, ValueError):
+                pass    # torn connection / garbage line: the client's
+                #         read fails and ITS TransportError carries the
+                #         verdict — nothing useful to do server-side
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+def serve_socket(server, port: int = 0,
+                 host: str = '127.0.0.1') -> SocketServer:
+    """Expose a `HostServer` on a TCP port; returns the running
+    `SocketServer` (its `.port` is the bound port — pass 0 to let the
+    OS pick, the worker prints it in its READY line)."""
+    return SocketServer(server.handle, port=port, host=host)

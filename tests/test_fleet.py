@@ -1,0 +1,499 @@
+"""Cross-host fleet tier tests (se3_transformer_tpu.serving.fleet /
+.transport): the transport contract (local AND socket arms, injected
+faults), the HostServer RPC surface over a real Router (fake engines —
+no compiles), the FleetRouter's host-level breaker walk / cross-host
+redispatch / canaried rollout with auto-rollback, the schema'd `fleet`
+record, and the graceful-shutdown satellite pinned with a REAL signal
+against `scripts/serve.py`."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from se3_transformer_tpu.faults import FaultInjector
+from se3_transformer_tpu.inference import AdmissionController
+from se3_transformer_tpu.inference.admission import (
+    RequestFailed, RequestRejected,
+)
+from se3_transformer_tpu.observability import PhaseTimer
+from se3_transformer_tpu.observability.schema import (
+    SchemaError, validate_record,
+)
+from se3_transformer_tpu.serving import (
+    FleetRouter, HealthConfig, HostServer, LocalTransport, ReplicaWorker,
+    Router, SocketTransport, TransportError, serve_socket,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _FakeEngine:
+    """Engine-shaped stand-in (no compiles): answers row indices scaled
+    by the params version so a weight swap is observable in outputs."""
+
+    def __init__(self, buckets=(4, 8), batch_size=2):
+        self.buckets = tuple(buckets)
+        self.batch_size = batch_size
+        self.rows_served = {b: 0 for b in self.buckets}
+        self._params = 'v0'
+        self.timer = PhaseTimer()
+        self.executables = {}
+        self.cost_payloads = {}
+        self.fail = False
+
+    @property
+    def params(self):
+        return self._params
+
+    @params.setter
+    def params(self, value):
+        self._params = value
+
+    def run(self, bucket, tokens, coords, mask):
+        if self.fail:
+            raise RuntimeError('engine down')
+        self.rows_served[bucket] += int(np.asarray(mask).any(-1).sum())
+        with self.timer.phase(f'bucket_{bucket}'):
+            pass
+        return np.broadcast_to(
+            np.arange(tokens.shape[1], dtype=np.float32)[None, :, None],
+            tokens.shape + (3,)).copy()
+
+
+class _KillableTransport(LocalTransport):
+    """LocalTransport with a kill switch: a dead transport raises
+    TransportError on every call — the SIGKILLed-host stand-in."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.dead = False
+
+    def call(self, method, payload=None, timeout_s=None):
+        if self.dead:
+            raise TransportError(f'{self.label}: connection refused '
+                                 f'(host dead)')
+        return super().call(method, payload, timeout_s=timeout_s)
+
+
+def _host(host_id, buckets=(4, 8), batch_size=2, max_retries=1,
+          on_swap=None):
+    engine = _FakeEngine(buckets, batch_size)
+    worker = ReplicaWorker(0, engine, max_wait_ms=5.0)
+    router = Router([worker],
+                    admission=AdmissionController(max_len=max(buckets)),
+                    max_retries=max_retries)
+    return HostServer(router, host_id=host_id, on_swap=on_swap), engine
+
+
+def _request(rng, length):
+    return (rng.randint(0, 8, size=length),
+            rng.normal(size=(length, 3)).astype(np.float32))
+
+
+def _fleet(n=3, transport_cls=_KillableTransport, injector=None,
+           max_retries=2, **kw):
+    servers, engines, transports = [], [], {}
+    for i in range(n):
+        s, e = _host(i)
+        servers.append(s)
+        engines.append(e)
+        transports[i] = transport_cls(s, fault_injector=injector)
+    kw.setdefault('health', HealthConfig(
+        quarantine_after=3, recover_after=2,
+        probe_backoff_s=0.02, probe_backoff_max_s=0.2))
+    kw.setdefault('heartbeat_every_s', 0.01)
+    fleet = FleetRouter(transports, max_retries=max_retries,
+                        default_timeout_s=10.0, **kw)
+    # scrape until the hosts reported their buckets (routing signals up)
+    t0 = time.monotonic()
+    while fleet.buckets is None and time.monotonic() - t0 < 5:
+        fleet.pump()
+        time.sleep(0.005)
+    fleet.drain()
+    assert fleet.buckets == (4, 8)
+    return fleet, servers, engines, transports
+
+
+def _shutdown(fleet, servers):
+    fleet.close()
+    for s in servers:
+        s.stop()
+
+
+# --------------------------------------------------------------------- #
+# transport contract: both arms, one behavior
+# --------------------------------------------------------------------- #
+def test_local_and_socket_transport_round_trip():
+    """ping/stats/infer behave identically over the in-process and the
+    socket arm; the host restart case (reconnect per call) is free."""
+    server, _ = _host(7)
+    sock = serve_socket(server, port=0)
+    rng = np.random.RandomState(0)
+    try:
+        for transport in (LocalTransport(server),
+                          SocketTransport('127.0.0.1', sock.port)):
+            res = transport.call('ping', timeout_s=5.0)
+            assert res['ok'] and res['host'] == 7
+            tokens, coords = _request(rng, 3)
+            res = transport.call('infer',
+                                 dict(tokens=tokens.tolist(),
+                                      coords=coords.tolist(),
+                                      timeout_s=5.0), timeout_s=10.0)
+            assert res['ok'] and len(res['result']) == 3
+            stats = transport.call('stats', timeout_s=5.0)['stats']
+            assert stats['host'] == 7 and stats['buckets'] == [4, 8]
+            assert 'p99_ms_by_bucket' in stats
+            res = transport.call('nope', timeout_s=5.0)
+            assert not res['ok']
+            assert res['error']['code'] == 'unknown_method'
+    finally:
+        sock.close()
+        server.stop()
+
+
+def test_socket_transport_refused_connection_is_transport_error():
+    server, _ = _host(0)
+    sock = serve_socket(server, port=0)
+    port = sock.port
+    sock.close()
+    server.stop()
+    with pytest.raises(TransportError):
+        SocketTransport('127.0.0.1', port, timeout_s=1.0).call('ping')
+
+
+def test_transport_fault_injection_latency_exception_drop():
+    """The seeded `transport` site: latency sleeps in place, exception
+    and the partition-style drop both surface as TransportError — and a
+    drop never reaches the host (the request was never sent)."""
+    server, _ = _host(0)
+    inj = FaultInjector(seed=0)
+    # one action per fire: a later plan is NOT consulted on a call an
+    # earlier plan acted on, so each plan's at= counts its OWN
+    # consultations — at=(1,) each fires them on calls 1, 2, 3
+    inj.plan('transport', 'latency', at=(1,), latency_s=0.01)
+    inj.plan('transport', 'exception', at=(1,))
+    inj.plan('transport', 'drop', at=(1,))
+    t = LocalTransport(server, fault_injector=inj)
+    try:
+        assert t.call('ping')['ok']                    # latency: served
+        with pytest.raises(TransportError):
+            t.call('ping')                             # injected reset
+        pings_before = server.calls['ping']
+        with pytest.raises(TransportError, match='partition'):
+            t.call('ping')                             # dropped
+        assert server.calls['ping'] == pings_before    # never sent
+        kinds = [e['kind'] for e in inj.injected]
+        assert kinds == ['latency', 'exception', 'drop']
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------------------- #
+# HostServer: the RPC surface over a real Router
+# --------------------------------------------------------------------- #
+def test_host_server_structured_rejection_and_deadline():
+    server, _ = _host(0)
+    t = LocalTransport(server)
+    rng = np.random.RandomState(0)
+    try:
+        tokens, coords = _request(rng, 64)     # oversize for buckets 4/8
+        res = t.call('infer', dict(tokens=tokens.tolist(),
+                                   coords=coords.tolist()))
+        assert not res['ok'] and res['error']['code'] == 'oversize'
+        tokens, coords = _request(rng, 3)
+        res = t.call('infer', dict(tokens=tokens.tolist(),
+                                   coords=coords.tolist(),
+                                   timeout_s=0.0))
+        assert not res['ok'] and res['error']['code'] == 'deadline'
+        # the satellite contract: structured terminal failures carry
+        # the same retry hint overload rejections do
+        assert res['error']['detail']['retry_after_s'] >= 0.0
+    finally:
+        server.stop()
+
+
+def test_host_server_swap_from_checkpoint_and_on_swap_hook(tmp_path):
+    from se3_transformer_tpu.training.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, dict(params=dict(w=np.ones(3))))
+    mgr.save(2, dict(params=dict(w=np.full(3, 2.0))))
+    mgr.close()
+    seen = []
+    server, engine = _host(0, on_swap=lambda payload, events:
+                           seen.append((payload.get('step'), events)))
+    t = LocalTransport(server)
+    try:
+        res = t.call('swap', dict(directory=str(tmp_path), step=2))
+        assert res['ok'] and res['tag'].endswith('@2')
+        assert np.allclose(engine.params['w'], 2.0)
+        assert seen and seen[0][0] == 2
+        res = t.call('swap', dict(directory=str(tmp_path), step=1))
+        assert res['tag'].endswith('@1')
+        assert np.allclose(engine.params['w'], 1.0)
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------------------- #
+# FleetRouter: placement, breaker walk, redispatch, zero-lost
+# --------------------------------------------------------------------- #
+def test_fleet_routes_and_answers_across_hosts():
+    fleet, servers, engines, _ = _fleet()
+    rng = np.random.RandomState(0)
+    pending = [fleet.submit(*_request(rng, int(rng.randint(1, 9))))
+               for _ in range(12)]
+    fleet.drain()
+    assert all(p.ok for p in pending)
+    # results sliced to true lengths
+    assert all(len(p.result) == p.length for p in pending)
+    served = [sum(e.rows_served.values()) for e in engines]
+    assert sum(served) >= 12
+    _shutdown(fleet, servers)
+
+
+def test_dead_host_quarantines_redispatch_answers_probe_recovers():
+    """The SIGKILL arc in miniature: every request still answers via
+    cross-host redispatch, the dead host's breaker walks to
+    quarantined, and after revival a half-open ping probe (issued by
+    pump, claimed atomically) closes it back — recovery observed in the
+    transition log with its host id."""
+    # heartbeats slowed to a crawl: the breaker walk below is driven by
+    # DISPATCH outcomes alone, and host 0 (the load-tie winner) is the
+    # victim so every fresh submit tries it first — deterministic
+    fleet, servers, engines, transports = _fleet(heartbeat_every_s=60.0)
+    rng = np.random.RandomState(0)
+    transports[0].dead = True
+    pending = []
+    for _ in range(6):
+        pending.append(fleet.submit(*_request(rng, 4)))
+        time.sleep(0.02)                    # paced: retry chain settles
+    fleet.drain()
+    assert all(p.ok for p in pending)       # zero lost, zero unanswered
+    assert fleet.cross_host_retries >= 1
+    # one dispatch failure DEGRADES the host and placement steers away
+    # from it (so it cannot fail its way to quarantine on traffic it no
+    # longer receives); heartbeat failures finish the walk — the real
+    # SIGKILL arc, where the silent host flunks its scrapes
+    assert fleet.health.state(0) == 'degraded'
+    fleet.heartbeat_every_s = 0.0
+    for _ in range(4):
+        fleet.pump()
+        fleet.drain()
+    assert fleet.health.state(0) == 'quarantined'
+    transports[0].dead = False              # "restart"
+    t0 = time.monotonic()
+    while fleet.health.recoveries == 0 and time.monotonic() - t0 < 5:
+        fleet.pump()
+        time.sleep(0.01)
+    fleet.drain()
+    assert fleet.health.recoveries >= 1
+    assert fleet.health.state(0) in ('degraded', 'healthy')
+    transitions = fleet.record_body(pending)['host_transitions']
+    assert any(e['host'] == 0 and e['from_state'] == 'quarantined'
+               for e in transitions)
+    _shutdown(fleet, servers)
+
+
+def test_all_hosts_dead_resolves_structured_with_retry_hint():
+    """Zero-lost under total failure: the retry budget spends, the
+    request resolves RequestFailed('retries_exhausted') through the
+    fleet's _fail_request choke point, carrying the machine-readable
+    retry_after_s backoff hint (the satellite contract)."""
+    fleet, servers, _, transports = _fleet(max_retries=1)
+    for t in transports.values():
+        t.dead = True
+    p = fleet.submit(*_request(np.random.RandomState(0), 4))
+    fleet.drain()
+    assert p.done and not p.ok
+    assert isinstance(p.error, RequestFailed)
+    assert p.error.code == 'retries_exhausted'
+    assert p.error.detail['retry_after_s'] >= 0.0
+    assert p.attempts == 2          # first try + one cross-host retry
+    for t in transports.values():
+        t.dead = False
+    _shutdown(fleet, servers)
+
+
+def test_weaken_hook_nulls_exclusion_and_gate_would_fire():
+    """`host_exclusion = False` (the chaos smoke's weakened arm): the
+    dead lowest-id host keeps winning load ties, paced requests exhaust
+    their budgets on it, and the all-answered gate has something to
+    catch — nothing is ever LOST (the structured contract holds even
+    weakened; only placement is broken)."""
+    fleet, servers, _, transports = _fleet(heartbeat_every_s=60.0)
+    fleet.host_exclusion = False
+    transports[0].dead = True
+    rng = np.random.RandomState(0)
+    pending = []
+    for _ in range(5):
+        pending.append(fleet.submit(*_request(rng, 4)))
+        time.sleep(0.02)            # paced: each retry chain settles
+    fleet.drain()
+    assert all(p.done for p in pending)           # zero lost, still
+    assert sum(1 for p in pending if not p.ok) == 5
+    transports[0].dead = False
+    _shutdown(fleet, servers)
+
+
+def test_deadline_propagates_and_expires_structured():
+    fleet, servers, _, _ = _fleet()
+    p = fleet.submit(*_request(np.random.RandomState(0), 4),
+                     timeout_s=0.0)
+    fleet.drain()
+    assert p.done and not p.ok
+    assert isinstance(p.error, (RequestFailed, RequestRejected))
+    assert p.error.code == 'deadline'
+    _shutdown(fleet, servers)
+
+
+# --------------------------------------------------------------------- #
+# canaried rollout: roll on a clean gate, AUTO-ROLL-BACK on a dirty one
+# --------------------------------------------------------------------- #
+def _ckpt(tmp_path):
+    from se3_transformer_tpu.training.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, dict(params=dict(w=np.ones(3))))
+    mgr.save(2, dict(params=dict(w=np.full(3, 2.0))))
+    mgr.close()
+    return (dict(directory=str(tmp_path), step=2),
+            dict(directory=str(tmp_path), step=1))
+
+
+def test_rollout_clean_canary_rolls_every_host(tmp_path):
+    fleet, servers, engines, _ = _fleet()
+    new_ref, old_ref = _ckpt(tmp_path)
+    rng = np.random.RandomState(0)
+    traffic = [_request(rng, 4) for _ in range(4)]
+    event, probes = fleet.rollout(new_ref, old_ref, traffic, canary=0)
+    assert event['passed'] and not event['rolled_back']
+    assert event['canary_tag'].endswith('@2')
+    assert {r['host'] for r in event['rolled']} == {1, 2}
+    assert all(r['tag'].endswith('@2') for r in event['rolled'])
+    assert all(p.ok for p in probes)
+    assert all(np.allclose(e.params['w'], 2.0) for e in engines)
+    assert fleet.rollouts == 1 and fleet.rollbacks == 0
+    _shutdown(fleet, servers)
+
+
+def test_rollout_poisoned_canary_auto_rolls_back(tmp_path):
+    """The load-bearing arc: the canary's new weights are bad (every
+    post-swap dispatch fails), the gate must FAIL on its probe traffic
+    + scraped failure delta, the canary must swap BACK, and the
+    siblings must never swap at all."""
+    fleet, servers, engines, _ = _fleet()
+    new_ref, old_ref = _ckpt(tmp_path)
+
+    # poison: host 0's engine fails while the params carry step 2's
+    # values, recovers when the rollback restores step 1's
+    real_setter = type(engines[0]).params.fset
+
+    def poisoned(self, value):
+        real_setter(self, value)
+        self.fail = bool(np.allclose(value['w'], 2.0))
+    type(engines[0]).params = property(
+        type(engines[0]).params.fget, poisoned)
+    try:
+        rng = np.random.RandomState(0)
+        traffic = [_request(rng, 4) for _ in range(4)]
+        event, probes = fleet.rollout(new_ref, old_ref, traffic,
+                                      canary=0)
+        assert not event['passed'] and event['rolled_back']
+        assert event['canary_tag'].endswith('@2')
+        assert event['rollback']['tag'].endswith('@1')
+        assert event['rolled'] == []
+        assert event['gate']['answered'] == 0
+        assert event['gate']['host_request_failures_delta'] >= 1
+        # zero-lost: the sacrificial probes resolved structurally
+        assert all(p.done and not p.ok for p in probes)
+        assert all(isinstance(p.error, RequestFailed) for p in probes)
+        # siblings untouched on the OLD weights; canary rolled back
+        assert engines[1].params == 'v0' and engines[2].params == 'v0'
+        assert np.allclose(engines[0].params['w'], 1.0)
+        assert fleet.rollbacks == 1 and fleet.rollouts == 0
+        # the rollout evidence lands in the fleet record, schema-valid
+        body = fleet.record_body(probes)
+        rec = dict(body, kind='fleet', run_id='t')
+        validate_record(rec)
+        assert rec['rollbacks'] == 1
+        assert rec['rollouts']['events'][0]['rolled_back']
+        assert rec['lost_requests'] == 0
+    finally:
+        type(engines[0]).params = property(
+            type(engines[0]).params.fget, real_setter)
+    _shutdown(fleet, servers)
+
+
+# --------------------------------------------------------------------- #
+# the `fleet` record schema: load-bearing fields cannot be dropped
+# --------------------------------------------------------------------- #
+def test_fleet_record_schema_load_bearing_fields():
+    fleet, servers, _, _ = _fleet()
+    body = fleet.record_body([])
+    base = dict(body, kind='fleet', run_id='t')
+    validate_record(base)
+    for field in ('lost_requests', 'hosts', 'host_transitions',
+                  'rollouts', 'rollbacks', 'recoveries',
+                  'cross_host_retries'):
+        broken = dict(base)
+        del broken[field]
+        with pytest.raises(SchemaError):
+            validate_record(broken)
+    with pytest.raises(SchemaError, match='state'):
+        validate_record(dict(base, hosts={'0': dict(depth=0)}))
+    with pytest.raises(SchemaError, match='non-negative'):
+        validate_record(dict(base, lost_requests=-1))
+    with pytest.raises(SchemaError, match='from_state'):
+        validate_record(dict(base, host_transitions=[dict(host=0)]))
+    with pytest.raises(SchemaError, match='canary'):
+        validate_record(dict(
+            base, rollouts=dict(count=1, events=[dict(t=0)])))
+    _shutdown(fleet, servers)
+
+
+# --------------------------------------------------------------------- #
+# graceful shutdown: a REAL signal against scripts/serve.py
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize('replicas', [1, 2])
+def test_serve_sigterm_drains_and_banks_telemetry(tmp_path, replicas):
+    """The satellite contract, pinned with a real SIGTERM: a mid-serve
+    preemption must stop admitting, drain what was accepted, flush the
+    final telemetry records, and exit 0 — not lose the bank. (Both the
+    single-replica and the router path install the handler.)"""
+    from se3_transformer_tpu.observability.schema import validate_stream
+    metrics = str(tmp_path / 'serve.jsonl')
+    out = str(tmp_path / 'summary.json')
+    cmd = [sys.executable, os.path.join(REPO, 'scripts', 'serve.py'),
+           '--cpu', '--requests', '500', '--oversize', '0',
+           '--buckets', '8', '--batch-size', '2', '--pace-ms', '25',
+           '--max-wait-ms', '200', '--replicas', str(replicas),
+           '--metrics', metrics, '--out', out]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            bufsize=1)
+    try:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            assert line, f'serve.py died during warmup: rc={proc.poll()}'
+            if 'warmup:' in line:
+                break
+        time.sleep(1.0)                     # let a few requests serve
+        proc.send_signal(signal.SIGTERM)    # the REAL signal
+        tail = proc.stdout.read()
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == 0, f'graceful SIGTERM must exit 0, got {rc}:\n{tail}'
+    assert 'graceful shutdown' in tail
+    info = validate_stream(metrics)         # the bank survived, valid
+    assert info['kinds'].get('serve', 0) >= 1
+    assert info['kinds'].get('summary', 0) >= 1
+    report = json.load(open(out))
+    assert report['ok'] and report['interrupted'] == 'SIGTERM'
+    assert report['requests']['answered'] >= 1

@@ -439,6 +439,125 @@ def test_abandoned_probe_rearms_instead_of_pinning_quarantine():
     assert mon.recoveries == 1
 
 
+def test_try_begin_probe_claims_atomically():
+    """try_begin_probe = probe_due + begin_probe under ONE lock: the
+    first claimer wins, the second sees half-open and backs off."""
+    from se3_transformer_tpu.serving import HealthConfig, HealthMonitor
+    clock = _Clock()
+    mon = HealthMonitor([0], HealthConfig(
+        degrade_after=1, quarantine_after=1, recover_after=2,
+        probe_backoff_s=1.0), clock=clock)
+    mon.record_failure(0)
+    assert mon.state(0) == 'quarantined'
+    clock.t += 1.5
+    assert mon.try_begin_probe(0)             # claimed
+    assert not mon.try_begin_probe(0)         # half-open: NOT re-claimed
+    mon.record_success(0)
+    assert mon.state(0) == 'degraded'
+
+
+def test_health_monitor_concurrent_hammer_never_double_books_probe():
+    """The PR 12 thread-safety claim, finally pinned: N threads hammer
+    record_success/record_failure/try_begin_probe on a shared monitor.
+    The breaker must never have two probes in flight for one member at
+    once, and the totals must reconcile exactly with what the threads
+    did — no lost update, no phantom probe."""
+    import threading
+
+    from se3_transformer_tpu.serving import HealthConfig, HealthMonitor
+    mon = HealthMonitor([0, 1], HealthConfig(
+        degrade_after=1, quarantine_after=2, recover_after=1,
+        probe_backoff_s=1e-4, probe_backoff_max_s=1e-3))
+    n_threads, per_thread = 8, 400
+    counts = [dict(successes=0, failures=0, probes=0)
+              for _ in range(n_threads)]
+    inflight = {0: 0, 1: 0}
+    inflight_lock = threading.Lock()
+    violations = []
+    barrier = threading.Barrier(n_threads)
+
+    def hammer(tid):
+        rng = np.random.RandomState(tid)
+        barrier.wait()
+        for i in range(per_thread):
+            member = int(rng.randint(0, 2))
+            roll = rng.rand()
+            if mon.try_begin_probe(member):
+                # the half-open slot was CLAIMED by this thread alone:
+                # at most one concurrent holder per member, ever
+                with inflight_lock:
+                    inflight[member] += 1
+                    if inflight[member] > 1:
+                        violations.append((tid, i, member))
+                counts[tid]['probes'] += 1
+                outcome_ok = roll < 0.5
+                with inflight_lock:
+                    inflight[member] -= 1
+                if outcome_ok:
+                    mon.record_success(member)
+                    counts[tid]['successes'] += 1
+                else:
+                    mon.record_failure(member)
+                    counts[tid]['failures'] += 1
+            elif roll < 0.6:
+                mon.record_failure(member, RuntimeError('x'))
+                counts[tid]['failures'] += 1
+            else:
+                mon.record_success(member)
+                counts[tid]['successes'] += 1
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads)
+    assert not violations, \
+        f'half-open probe double-booked: {violations[:5]}'
+    want_s = sum(c['successes'] for c in counts)
+    want_f = sum(c['failures'] for c in counts)
+    want_p = sum(c['probes'] for c in counts)
+    got_s = sum(mon[m].successes_total for m in (0, 1))
+    got_f = sum(mon[m].failures_total for m in (0, 1))
+    got_p = sum(mon[m].probes for m in (0, 1))
+    assert (got_s, got_f, got_p) == (want_s, want_f, want_p), \
+        'counters do not reconcile — a lock was dropped somewhere'
+    assert not any(mon[m].probe_inflight for m in (0, 1))
+    # the transition log stayed consistent: every event carries a
+    # legal from/to pair and the states are walkable in order
+    for m in (0, 1):
+        for e in mon[m].transitions:
+            assert e['from_state'] != e['to_state']
+
+
+def test_structured_failures_carry_retry_after_hint():
+    """The satellite contract: RequestFailed (retries_exhausted AND
+    deadline) carries the same machine-readable retry_after_s hint
+    RequestRejected's overload shed already does — wired through the
+    one _fail_request choke point."""
+    from se3_transformer_tpu.inference.admission import RequestFailed
+    router, engines, clock, _ = _health_router(n=2, max_retries=1)
+    engines[0].fail_next = 5
+    engines[1].fail_next = 5
+    rng = np.random.RandomState(0)
+    p = router.submit(*_request(rng, 3))
+    router.pump()
+    router.pump()
+    assert isinstance(p.error, RequestFailed)
+    assert p.error.code == 'retries_exhausted'
+    assert p.error.detail['retry_after_s'] >= 0.0
+    # deadline failures carry it too
+    router2, _, clock2, _ = _health_router(n=1, timeout_s=5.0)
+    p2 = router2.submit(*_request(rng, 5))    # batch_size=1 dispatches
+    p3 = router2.submit(*_request(rng, 3), timeout_s=0.0)
+    clock2.t += 0.1
+    router2.pump()
+    assert p3.done and p3.error.code == 'deadline'
+    assert p3.error.detail['retry_after_s'] >= 0.0
+    assert p2.ok
+
+
 def test_failed_batch_redispatches_to_sibling_and_succeeds():
     """The retry tentpole: a failed dispatch's requests are taken over
     (NOT resolved-with-raw-error), redispatched onto the sibling at the

@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke serve-fleet-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke flash-smoke chaos-smoke train-chaos-smoke quant-smoke perf-gate clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke serve-fleet-smoke slo-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke flash-smoke chaos-smoke train-chaos-smoke quant-smoke perf-gate clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -85,6 +85,14 @@ serve-fleet-smoke: ## cross-host fleet gate (docs/ROBUSTNESS.md "Fleet fault dom
 	python scripts/obs_report.py /tmp/fleet_chaos.jsonl --validate --require fleet --out /tmp/fleet_chaos_report.json
 	python scripts/perf_gate.py /tmp/fleet_chaos.jsonl
 	python scripts/fleet_chaos_smoke.py --weaken noexclude >/tmp/fleet_weaken.log 2>&1; test $$? -eq 1 || { echo "serve-fleet-smoke weakened arm did NOT fire with rc=1 — nulled host exclusion went undetected; output:"; cat /tmp/fleet_weaken.log; exit 1; }  # rc=1 is the gates FIRING on the dead host eating traffic; any other rc (crash, argparse) fails loudly with the evidence
+
+slo-smoke:         ## fleet observability gate (docs/OBSERVABILITY.md "Fleet dashboard"): 2 traced in-process hosts under seeded transport faults — every resolved request yields ONE complete single-root span tree (zero orphans), redispatched requests show multi-host traces reconciling with the cross_host_retries counter, merged-histogram fleet percentiles + availability land in schema'd trace/slo records (--require trace,slo), the dashboard renders, and the fleet perf budgets judge the stream; then the --inject-regression arm (fleet-side attempt spans discarded) must exit rc==1, proving the completeness gates fire
+	rm -f /tmp/slo_smoke.jsonl
+	python scripts/slo_smoke.py --metrics /tmp/slo_smoke.jsonl --out /tmp/slo_smoke_summary.json
+	python scripts/obs_report.py /tmp/slo_smoke.jsonl --validate --require trace,slo --out /tmp/slo_smoke_report.json
+	python scripts/slo_report.py /tmp/slo_smoke.jsonl --out /tmp/slo_dashboard.json
+	python scripts/perf_gate.py /tmp/slo_smoke.jsonl
+	python scripts/slo_smoke.py --metrics /tmp/slo_inject.jsonl --inject-regression >/tmp/slo_inject.log 2>&1; test $$? -eq 1 || { echo "slo-smoke injected arm did NOT fire with rc=1 — broken instrumentation (orphaned spans) went undetected; output:"; cat /tmp/slo_inject.log; exit 1; }  # rc=1 is the completeness gate FIRING on orphan spans; any other rc (crash, argparse) fails loudly with the evidence
 
 train-chaos-smoke: ## self-healing training gate (docs/ROBUSTNESS.md "Training fault domain"): an injected-NaN step + a real mid-run SIGTERM over the guarded elastic loop — the run must roll back (>=1 observed), exit resumable, resume, and finish BIT-EXACT vs an uninterrupted control arm with zero post-warmup recompiles; schema'd guard records (--require guard: injections >= 1, diverged == false), judged by the train-chaos perf budgets; then the WEAKENED arm (rollback nulled) must exit rc==1, proving the diverged gate fires
 	rm -f /tmp/train_chaos.jsonl

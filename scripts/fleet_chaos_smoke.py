@@ -42,7 +42,12 @@ Exit is non-zero unless ALL of:
   * the planned transport faults fired (latency + drop);
   * zero post-warmup compiles on every host (scraped at the end);
   * every host exits 0 on graceful SIGTERM (the shutdown satellite);
-  * the banked stream (run_meta + schema'd `fleet` records) validates.
+  * tracing survives the chaos: zero orphan spans and completeness 1.0
+    across the SIGKILL (the dead host's spans die with it; the
+    fleet-side tree must stay single-rooted through the redispatch)
+    and >= 1 multi-host trace;
+  * the banked stream (run_meta + schema'd `fleet`/`trace` records)
+    validates.
 
 `--weaken noexclude` is the injection arm of the `make
 serve-fleet-smoke` pair: host exclusion is NULLED (placement ignores
@@ -122,7 +127,9 @@ def main(argv=None):
         build_module_and_params, spawn_host, stop_host, wait_host_ready,
     )
     from se3_transformer_tpu.faults import FaultInjector
-    from se3_transformer_tpu.observability import MetricLogger
+    from se3_transformer_tpu.observability import (
+        MetricLogger, Tracer, trace_record_body,
+    )
     from se3_transformer_tpu.observability.report import (
         summarize_fleet_records,
     )
@@ -211,11 +218,17 @@ def main(argv=None):
         return (rng.randint(0, cfg.num_tokens, size=length),
                 rng.normal(size=(length, 3)).astype(np.float32))
 
+    # every submit is traced: under the SIGKILL the dead host's own
+    # spans are simply lost with the process, but the fleet-side span
+    # tree must STAY complete (the failed attempt ends transport_error,
+    # the redispatch hop is recorded, the retry attempt carries the
+    # sibling host) — zero orphans even across a host death
+    tracer = Tracer(origin='fleet')
     with FleetRouter(transports, health=health,
                      max_retries=args.max_retries,
                      default_timeout_s=args.timeout_s,
                      heartbeat_every_s=0.2,
-                     stale_after_s=3.0) as fleet:
+                     stale_after_s=3.0, tracer=tracer) as fleet:
         if weakened:
             # THE WEAKENED ARM: the exclusion mechanism — quarantine
             # filtering, failed-host avoidance, health-ranked placement
@@ -339,6 +352,10 @@ def main(argv=None):
                 final_stats[hid] = dict(error=str(e))
         body = fleet.record_body(pending, label='fleet_chaos')
         logger.log_record('fleet', mirror=False, **body)
+        resolved = sum(1 for p in pending if p.done)
+        trace_body = trace_record_body(tracer, label='fleet_chaos',
+                                       expected=resolved)
+        logger.log_record('trace', mirror=False, **trace_body)
     logger.close()
 
     # ---- graceful shutdown: every host must exit 0 on SIGTERM -------- #
@@ -419,6 +436,24 @@ def main(argv=None):
             if rc != 0:
                 print(f'--- host {i} tail ---')
                 print(''.join(sinks[i][-15:]) if i < len(sinks) else '?')
+    # tracing must survive the chaos: a SIGKILLed host takes its own
+    # spans down with it, but every fleet-side tree must stay complete
+    # — a single orphan means some latency can no longer be attributed
+    if trace_body['orphan_spans'] != 0:
+        print(f'FAIL: {trace_body["orphan_spans"]} orphan span(s) '
+              f'under SIGKILL/redispatch — the span trees must stay '
+              f'single-rooted across a host death')
+        ok = False
+    if trace_body['completeness_total'] < 1.0:
+        print(f'FAIL: trace completeness '
+              f'{trace_body["completeness_total"]} < 1.0 '
+              f'({trace_body["complete_trees"]}/{trace_body["traces"]} '
+              f'complete over {resolved} resolved)')
+        ok = False
+    if trace_body['multi_host_traces'] < 1:
+        print('FAIL: no multi-host trace — a redispatched request '
+              'must show attempts on >= 2 hosts')
+        ok = False
     if args.metrics:
         try:
             info = validate_stream(args.metrics)
@@ -440,6 +475,10 @@ def main(argv=None):
         fleet=summarize_fleet_records(
             [dict(body, kind='fleet')]),
         rollout=rollout_event,
+        trace={k: trace_body[k] for k in (
+            'traces', 'complete_trees', 'orphan_spans',
+            'multi_host_traces', 'redispatch_hops',
+            'completeness_total')},
         injections=by_site,
         host_rcs=rcs,
         post_warmup_compiles=compiles,

@@ -384,12 +384,65 @@ def _gate_quant_ab(records):
     return True
 
 
+def _gate_trace(records):
+    recs = [r for r in records if r.get('kind') == 'trace']
+    if not recs:
+        print('TRACE GATE: no trace records in the stream (was '
+              'scripts/slo_smoke.py / fleet_chaos_smoke.py run?)',
+              file=sys.stderr)
+        return False
+    last = recs[-1]
+    if not last.get('complete_trees'):
+        print(f'TRACE GATE: zero complete span trees (traces='
+              f'{last.get("traces")}) — no request produced a '
+              f'single-root tree', file=sys.stderr)
+        return False
+    if last.get('orphan_spans'):
+        print(f'TRACE GATE: {last["orphan_spans"]} orphan span(s) — '
+              f'spans whose parent never appears in their trace '
+              f'(instrumentation lost part of a request\'s story)',
+              file=sys.stderr)
+        return False
+    print(f'trace gate ok: {last.get("complete_trees")}/'
+          f'{last.get("traces")} complete trees, zero orphans, '
+          f'{last.get("multi_host_traces")} multi-host trace(s), '
+          f'{last.get("redispatch_hops")} redispatch hop(s) '
+          f'(completeness_total itself is enforced by '
+          f'scripts/perf_gate.py)', file=sys.stderr)
+    return True
+
+
+def _gate_slo(records):
+    recs = [r for r in records if r.get('kind') == 'slo']
+    if not recs:
+        print('SLO GATE: no slo records in the stream (was '
+              'scripts/slo_smoke.py run?)', file=sys.stderr)
+        return False
+    last = recs[-1]
+    if not last.get('answered'):
+        print('SLO GATE: zero answered requests — the record proves '
+              'no served traffic', file=sys.stderr)
+        return False
+    avail = last.get('availability')
+    if not isinstance(avail, (int, float)):
+        print(f'SLO GATE: availability {avail!r} is not numeric',
+              file=sys.stderr)
+        return False
+    print(f'slo gate ok: {last.get("hosts")} host(s), availability '
+          f'{avail}, {last.get("answered")} answered, buckets '
+          f'{sorted(last.get("buckets") or {})} (the availability '
+          f'floor itself is enforced by scripts/perf_gate.py)',
+          file=sys.stderr)
+    return True
+
+
 _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       tune=_gate_tune, cost=_gate_cost,
                       profile=_gate_profile, serve=_gate_serve,
                       so2_sweep=_gate_so2_sweep, flash=_gate_flash,
                       fault=_gate_fault, guard=_gate_guard,
-                      fleet=_gate_fleet, quant_ab=_gate_quant_ab)
+                      fleet=_gate_fleet, quant_ab=_gate_quant_ab,
+                      trace=_gate_trace, slo=_gate_slo)
 
 
 def main(argv=None):
@@ -419,7 +472,10 @@ def main(argv=None):
                          'present and zero lost requests; guard: '
                          'injections present and diverged == false; '
                          'fleet: host-breaker transitions present and '
-                         'zero lost requests fleet-wide) '
+                         'zero lost requests fleet-wide; trace: at '
+                         'least one complete span tree and zero '
+                         'orphan spans; slo: nonzero answered and a '
+                         'numeric availability) '
                          'and exits non-zero on failure')
     # legacy aliases for the unified --require flag (kept: Makefiles and
     # session scripts in the wild still pass them)

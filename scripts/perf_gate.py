@@ -78,11 +78,15 @@ DEFAULT_BUDGETS = os.path.join(REPO, 'PERF_BUDGETS.json')
 # FLEET_CHAOS.jsonl: the banked `make serve-fleet-smoke` cross-host
 # stream, so the fleet-wide zero-lost contract, the observed host
 # quarantine->recovery, and the canary auto-rollback are judged too.
+# SLO_SMOKE.jsonl: the banked `make slo-smoke` traced-fleet stream, so
+# the fleet availability floor and the trace-completeness invariant
+# (every resolved request = one complete single-root span tree) are
+# judged by a plain `make perf-gate`.
 DEFAULT_RECORDS = ('BENCH_r05.json', 'WIDTH_TABLE.jsonl',
                    'SERVE_MULTI.jsonl', 'SO2_SWEEP.jsonl',
                    'FLASH_AB.jsonl', 'CHAOS_SMOKE.jsonl',
                    'QUANT_AB.jsonl', 'TRAIN_CHAOS.jsonl',
-                   'FLEET_CHAOS.jsonl')
+                   'FLEET_CHAOS.jsonl', 'SLO_SMOKE.jsonl')
 
 
 # --------------------------------------------------------------------- #

@@ -1,0 +1,269 @@
+"""Fleet observability smoke: request tracing + SLO aggregation.
+
+The `make slo-smoke` gate. Runs a 2-host in-process fleet (real AOT
+engines behind `HostServer` + `LocalTransport`) with SEEDED transport
+faults (deterministic latency + drops on `infer`, so some requests are
+forced to redispatch cross-host), streams a mixed-length request load
+through a traced `FleetRouter`, and banks two schema'd records off one
+run: `trace` (span trees + the completeness invariant) and `slo`
+(fleet availability + merged-histogram percentiles + error-budget
+burn). The fleet-level zero-lost claim is gated in-process; no `fleet`
+record is banked (this run exercises no rollout/recovery, and one
+would shadow the chaos smoke's record under the perf gate's
+last-matching-record semantics).
+
+Exits non-zero when any of the load-bearing claims fails:
+
+  * any request resolves neither answered nor structured-failed
+    (zero-lost, fleet-wide);
+  * any orphan span, or completeness_total < 1.0 — every answered OR
+    structured-failed request must yield exactly one single-root span
+    tree;
+  * redispatch_hops != the fleet's cross_host_retries counter (the
+    trace record must RECONCILE with the counters, not approximate
+    them);
+  * no multi-host trace (the seeded drops force redispatch — a
+    redispatched request must show spans from >= 2 hosts);
+  * fleet availability under the floor, or zero answered requests;
+  * the stream fails schema validation.
+
+`--inject-regression` proves the gate can fire: after the (healthy)
+run, the tracer's fleet-side `attempt` spans are discarded — the
+broken-instrumentation simulation: every host-recorded span loses its
+parent, the trace record reports orphans and completeness < 1.0, and
+this script must exit 1 (the Makefile inverts it).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from se3_transformer_tpu.utils.compilation_cache import (  # noqa: E402
+    enable_compilation_cache,
+)
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description='2-host traced fleet smoke: trace + slo records')
+    ap.add_argument('--requests', type=int, default=40)
+    ap.add_argument('--buckets', default='4,8')
+    ap.add_argument('--batch-size', type=int, default=2)
+    ap.add_argument('--seed', type=int, default=0)
+    ap.add_argument('--timeout-s', type=float, default=20.0)
+    ap.add_argument('--metrics', default='/tmp/slo_smoke.jsonl')
+    ap.add_argument('--out', default=None,
+                    help='also write the summary JSON here')
+    ap.add_argument('--inject-regression', action='store_true',
+                    help='discard the fleet-side attempt spans after '
+                         'the run (broken instrumentation): the trace '
+                         'gates MUST fire and this script exits 1')
+    return ap.parse_args(argv)
+
+
+def build_hosts(args, buckets):
+    """Two in-process hosts: real AOT engines, one Router +
+    RouterTelemetry + HostServer each, both telemetries banking into
+    ONE MetricLogger (per-host serve records interleave in the same
+    stream the fleet records land in)."""
+    from serve import build_module_and_params
+
+    from se3_transformer_tpu.faults import FaultInjector
+    from se3_transformer_tpu.inference import AdmissionController
+    from se3_transformer_tpu.observability import (
+        MetricLogger, PhaseTimer,
+    )
+    from se3_transformer_tpu.inference.engine import InferenceEngine
+    from se3_transformer_tpu.serving import (
+        HostServer, LocalTransport, ReplicaWorker, Router,
+        RouterTelemetry,
+    )
+
+    cfg, module, params = build_module_and_params(args, buckets)
+    logger = MetricLogger(args.metrics, run_meta=dict(
+        mode='slo_smoke', hosts=2, buckets=list(buckets),
+        batch_size=args.batch_size, seed=args.seed))
+    injector = FaultInjector(seed=args.seed)
+    # deterministic transport chaos: periodic latency plus infer drops —
+    # each dropped RPC surfaces as a TransportError at the fleet tier,
+    # feeds the host breaker, and forces a CROSS-HOST redispatch (the
+    # multi-host-trace evidence this smoke gates on)
+    injector.plan('transport', 'latency', every=9, latency_s=0.02)
+    injector.plan('transport', 'drop', at=(4, 11),
+                  match=dict(method='infer'))
+
+    hosts, transports, telemetries = {}, {}, {}
+    t0 = time.perf_counter()
+    # BOTH engines compile before EITHER telemetry arms: compile events
+    # are process-wide, so arming host 0 first would book host 1's
+    # warmup compiles as post-warmup retraces on host 0's records
+    engines = {hid: InferenceEngine(
+        module, params, buckets=buckets, batch_size=args.batch_size,
+        return_type=1, timer=PhaseTimer()) for hid in (0, 1)}
+    for hid, engine in engines.items():
+        worker = ReplicaWorker(0, engine, max_wait_ms=5.0)
+        admission = AdmissionController(max_len=buckets[-1])
+        router = Router([worker], admission=admission, max_retries=1,
+                        default_timeout_s=args.timeout_s)
+        telemetry = RouterTelemetry(router, admission, logger)
+        telemetry.arm(emit_cost_records=False)
+        server = HostServer(router, host_id=hid, telemetry=telemetry,
+                            flush_every_batches=4)
+        hosts[hid] = server
+        telemetries[hid] = telemetry
+        transports[hid] = LocalTransport(server,
+                                         fault_injector=injector)
+    print(f'warmup: 2 hosts x {len(buckets)} bucket executables in '
+          f'{time.perf_counter() - t0:.1f}s', flush=True)
+    return hosts, transports, telemetries, logger, injector
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    enable_compilation_cache()
+    import numpy as np
+
+    from se3_transformer_tpu.observability import (
+        SLOAggregator, Tracer, trace_record_body,
+    )
+    from se3_transformer_tpu.observability.schema import (
+        SchemaError, validate_stream,
+    )
+    from se3_transformer_tpu.observability.slo import AVAILABILITY_FLOOR
+    from se3_transformer_tpu.serving import FleetRouter
+
+    buckets = tuple(int(b) for b in args.buckets.split(','))
+    args.checkpoint = None
+    hosts, transports, telemetries, logger, injector = \
+        build_hosts(args, buckets)
+
+    tracer = Tracer(origin='fleet')
+    slo = SLOAggregator(availability_target=0.999)
+    rng = np.random.RandomState(args.seed)
+    pending = []
+    with FleetRouter(transports, max_retries=2,
+                     default_timeout_s=args.timeout_s,
+                     heartbeat_every_s=0.05,
+                     tracer=tracer, slo=slo) as fleet:
+        for i in range(args.requests):
+            n = int(rng.randint(1, buckets[-1] + 1))
+            pending.append(fleet.submit(
+                rng.randint(0, 24, size=n).astype(np.int32),
+                rng.normal(size=(n, 3)).astype(np.float32)))
+            fleet.pump()
+            time.sleep(0.004)
+        # settle: every submit resolves (answered or structured) and
+        # the heartbeat loop keeps scraping the hosts' histograms
+        deadline = time.monotonic() + args.timeout_s + 30.0
+        while (any(not p.done for p in pending)
+               and time.monotonic() < deadline):
+            fleet.drain()
+            fleet.pump()
+            time.sleep(0.01)
+        fleet.drain()
+        scraped = fleet.scrape()    # final cumulative counters
+        fleet_body = fleet.record_body(pending, label='slo_smoke')
+        answered = fleet.answered
+        failures = fleet.request_failures
+        xretries = fleet.cross_host_retries
+
+    for s in hosts.values():
+        s.stop(drain=True)
+    for t in telemetries.values():
+        t.flush()
+
+    if args.inject_regression:
+        # broken-instrumentation simulation: dropping the fleet-side
+        # `attempt` spans orphans every host-recorded span (their
+        # parent ids vanish from the trace) — the orphan/completeness
+        # gates below and the perf budgets must all fire
+        with tracer._lock:
+            tracer._spans = [s for s in tracer._spans
+                             if s.get('name') != 'attempt']
+        print('INJECTED REGRESSION: fleet-side attempt spans '
+              'discarded — host spans are now orphans', flush=True)
+
+    resolved = answered + failures
+    trace_body = trace_record_body(tracer, label='slo_smoke',
+                                   expected=resolved)
+    slo_body = slo.record_body(fleet, label='slo_smoke')
+    # no `fleet` record here: this run exercises no rollout/recovery,
+    # and banking one would shadow the chaos smoke's record under the
+    # perf gate's last-matching-record semantics — the fleet-level
+    # claims (zero lost) are gated in-process off fleet_body below
+    logger.log_record('trace', mirror=False, **trace_body)
+    logger.log_record('slo', mirror=False, **slo_body)
+    logger.close()
+
+    ok = True
+
+    def gate(cond, msg):
+        nonlocal ok
+        if not cond:
+            print(f'FAIL: {msg}')
+            ok = False
+
+    gate(answered > 0, 'zero answered requests')
+    gate(fleet_body['lost_requests'] == 0,
+         f'{fleet_body["lost_requests"]} lost request(s) — resolved '
+         f'neither answered nor structured')
+    gate(trace_body['orphan_spans'] == 0,
+         f'{trace_body["orphan_spans"]} orphan span(s)')
+    gate(trace_body['completeness_total'] >= 1.0,
+         f'trace completeness {trace_body["completeness_total"]} < 1.0 '
+         f'({trace_body["complete_trees"]}/{trace_body["traces"]} '
+         f'complete over {resolved} resolved)')
+    gate(trace_body['redispatch_hops'] == xretries,
+         f'redispatch_hops {trace_body["redispatch_hops"]} != '
+         f'cross_host_retries {xretries} — the trace record does not '
+         f'reconcile with the fleet counters')
+    gate(trace_body['multi_host_traces'] >= 1,
+         'no multi-host trace — the seeded drops must force at least '
+         'one cross-host redispatch with spans from both hosts')
+    gate(isinstance(slo_body['availability'], (int, float))
+         and slo_body['availability'] >= AVAILABILITY_FLOOR,
+         f'fleet availability {slo_body["availability"]} under the '
+         f'{AVAILABILITY_FLOOR} floor')
+    gate(slo_body['hosts'] == 2 and scraped == 2,
+         f'SLO aggregator saw {slo_body["hosts"]} host(s), final '
+         f'scrape hit {scraped} — both hosts must report')
+    gate(any(v.get('count') for v in slo_body['buckets'].values()),
+         'merged histograms are empty — no host shipped latency '
+         'counts')
+
+    try:
+        validate_stream(args.metrics)
+        print(f'schema: {args.metrics} validated clean')
+    except SchemaError as e:
+        gate(False, f'schema violation: {e}')
+
+    summary = dict(
+        answered=answered, request_failures=failures,
+        cross_host_retries=xretries,
+        injections=injector.snapshot()['injections_total'],
+        trace={k: trace_body[k] for k in (
+            'traces', 'complete_trees', 'orphan_spans',
+            'multi_host_traces', 'redispatch_hops',
+            'completeness_total')},
+        availability=slo_body['availability'],
+        buckets=slo_body['buckets'],
+        ok=ok,
+    )
+    print(json.dumps(summary, indent=2))
+    if args.out:
+        with open(args.out, 'w') as f:
+            json.dump(summary, f, indent=2)
+    if ok:
+        print(f'SLO SMOKE PASS: {answered} answered, {xretries} '
+              f'cross-host redispatches all traced, availability '
+              f'{slo_body["availability"]}')
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -43,14 +43,18 @@ class PendingResult:
     `deadline` (absolute, same clock as `submitted_at`; None = no
     timeout) propagates the caller's `timeout_s` through every queue
     and redispatch; `attempts` counts dispatches that FAILED under this
-    request (the router's bounded-retry budget)."""
+    request (the router's bounded-retry budget). `trace` is the
+    request-tracing context (observability.tracing) — a dict carrying
+    the trace id and the parent span id the next tier hangs its spans
+    under; None (the default) keeps every tracing site zero-cost."""
 
     __slots__ = ('request_id', 'length', 'bucket', 'result', 'done',
                  'error', 'submitted_at', 'completed_at', 'deadline',
-                 'attempts')
+                 'attempts', 'trace')
 
-    def __init__(self, request_id: int, length: int, bucket: int,
-                 submitted_at: float, deadline: Optional[float] = None):
+    def __init__(self, request_id, length: int, bucket: int,
+                 submitted_at: float, deadline: Optional[float] = None,
+                 trace: Optional[dict] = None):
         self.request_id = request_id
         self.length = length
         self.bucket = bucket
@@ -61,6 +65,7 @@ class PendingResult:
         self.completed_at: Optional[float] = None
         self.deadline = deadline
         self.attempts = 0
+        self.trace = trace
 
     @property
     def ok(self) -> bool:
@@ -82,7 +87,8 @@ def dispatch_batch(runner, bucket: int, batch_size: int, tokens, coords,
                    completed_capacity: int,
                    clock: Callable[[], float],
                    on_success: Optional[Callable[[int], None]] = None,
-                   on_failure: Optional[Callable] = None) -> None:
+                   on_failure: Optional[Callable] = None,
+                   tracer=None) -> None:
     """THE dispatch body — pad, run, resolve — shared by `MicroBatcher`
     (deadline micro-batching) and `serving.ContinuousBatcher`
     (in-flight slots), so the pad/slice/error contract cannot drift
@@ -98,13 +104,23 @@ def dispatch_batch(runner, bucket: int, batch_size: int, tokens, coords,
     (the router's retry queue will redispatch or structurally fail
     each one) — dispatch_batch then neither resolves nor re-raises.
     The hooks receive the ORIGINAL per-request arrays, not the padded
-    batch, so a redispatch re-pads for its new bucket slot."""
+    batch, so a redispatch re-pads for its new bucket slot.
+
+    `tracer` (observability.tracing.Tracer, optional) records
+    queue_wait / dispatch / device_run spans for every request that
+    carries a trace context (`p.trace`); None keeps dispatch span-free.
+    """
     raw_tokens, raw_coords = list(tokens), list(coords)
+    t_start = clock()
     tokens, coords, mask = pad_to_bucket(tokens, coords, bucket,
                                          batch_size=batch_size)
+    t_run = clock()
     try:
         out = np.asarray(runner(bucket, tokens, coords, mask))
     except Exception as e:
+        t_done = clock()
+        _trace_batch(tracer, bucket, pending, t_start, t_run, t_done,
+                     error=type(e).__name__)
         if on_failure is not None and \
                 on_failure(bucket, raw_tokens, raw_coords, pending, e):
             return      # requests taken over by the retry path
@@ -117,7 +133,8 @@ def dispatch_batch(runner, bucket: int, batch_size: int, tokens, coords,
         if len(completed) > completed_capacity:
             del completed[:-completed_capacity]
         raise
-    now = clock()
+    t_done = now = clock()
+    _trace_batch(tracer, bucket, pending, t_start, t_run, t_done)
     for row, p in enumerate(pending):
         # copy: a view would pin the whole [B, L, ...] batch output
         # alive for as long as any single request's result is held
@@ -129,6 +146,32 @@ def dispatch_batch(runner, bucket: int, batch_size: int, tokens, coords,
         del completed[:-completed_capacity]
     if on_success is not None:
         on_success(len(pending))
+
+
+def _trace_batch(tracer, bucket, pending, t_start, t_run, t_done,
+                 error=None):
+    """Record queue_wait / dispatch / device_run spans for each traced
+    request of one dispatched batch. The device_run span nests under
+    the dispatch span (exclusive dispatch time = pad + resolve
+    overhead); a failing runner stamps the error class on the dispatch
+    span so retried attempts are tellable apart in the tree."""
+    if tracer is None:
+        return
+    for p in pending:
+        tr = getattr(p, 'trace', None)
+        if not tr:
+            continue
+        tracer.add(tr['ctx'], 'queue_wait', parent_id=tr['parent'],
+                   ts=p.submitted_at,
+                   dur_ms=(t_start - p.submitted_at) * 1e3)
+        meta = dict(bucket=int(bucket), fill=len(pending))
+        if error is not None:
+            meta['error'] = error
+        d = tracer.add(tr['ctx'], 'dispatch', parent_id=tr['parent'],
+                       ts=t_start, dur_ms=(t_done - t_start) * 1e3,
+                       **meta)
+        tracer.add(tr['ctx'], 'device_run', parent_id=d['span'],
+                   ts=t_run, dur_ms=(t_done - t_run) * 1e3)
 
 
 class _BucketQueue:
@@ -173,6 +216,12 @@ class MicroBatcher:
         self.clock = clock
         self._queues = {b: _BucketQueue(b) for b in self.buckets}
         self._next_id = 0
+        # request ids are monotonic ints PER BATCHER — merged record
+        # streams from several replicas/hosts would collide, so owners
+        # (Router/HostServer) set id_prefix to a host/replica component
+        # and ids become globally unique strings like 'h0-r1-42'
+        self.id_prefix: Optional[str] = None
+        self.tracer = None             # observability.tracing.Tracer
         self.batches_dispatched = 0
         self.rows_dispatched = 0       # real (non-dummy) rows
         # real rows per dispatched batch: exact running stats forever,
@@ -216,7 +265,9 @@ class MicroBatcher:
         if self.admission is not None:
             self.admission.admit(length, queue_depth=self.queue_depth)
         q = self._queues[bucket]
-        pending = PendingResult(self._next_id, length, bucket, self.clock())
+        rid = (self._next_id if self.id_prefix is None
+               else f'{self.id_prefix}-{self._next_id}')
+        pending = PendingResult(rid, length, bucket, self.clock())
         self._next_id += 1
         q.tokens.append(tokens)
         q.coords.append(np.asarray(coords, np.float32).reshape(-1, 3))
@@ -269,7 +320,8 @@ class MicroBatcher:
         q.tokens, q.coords, q.pending = [], [], []
         dispatch_batch(self.runner, q.bucket, self.batch_size, tokens,
                        coords, pending, self.completed,
-                       self._completed_capacity, self.clock)
+                       self._completed_capacity, self.clock,
+                       tracer=self.tracer)
         self.batches_dispatched += 1
         self.rows_dispatched += len(pending)
         agg_update(self.fill_stats, [len(pending)])

@@ -26,6 +26,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..observability import MetricLogger, PhaseTimer, RetraceWatchdog
+from ..observability.slo import LatencyHistogram
 from .admission import AdmissionController
 from .batching import MicroBatcher
 from .engine import InferenceEngine, bucket_phase
@@ -53,6 +54,15 @@ class ServeTelemetryBase:
         self._armed = False
         self._latency_agg = agg_zero()
         self.flush_count = 0
+        # mergeable per-bucket latency histograms (observability.slo):
+        # fixed boundaries shared fleet-wide, so the FleetRouter's
+        # aggregator can add counts across hosts and read EXACT merged
+        # percentiles — plus the cumulative answered/failed counters
+        # the fleet availability computation needs
+        self.latency_hist: dict = {}
+        self.answered_total = 0
+        self.failed_total = 0
+        self._window_ms: list = []
 
     # hooks ------------------------------------------------------------- #
     def _pop_completed(self):
@@ -101,10 +111,50 @@ class ServeTelemetryBase:
         return requests
 
     def _drain_latencies(self):
-        ms = [p.latency_s * 1e3 for p in self._pop_completed()
-              if p.latency_s is not None]
+        ms = []
+        for p in self._pop_completed():
+            if p.latency_s is not None:
+                lat = p.latency_s * 1e3
+                ms.append(lat)
+                if p.ok:
+                    # only ANSWERED latencies feed the SLO histograms —
+                    # a timeout's latency is the deadline, not service
+                    self.latency_hist.setdefault(
+                        str(p.bucket), LatencyHistogram()).observe(lat)
+            if p.ok:
+                self.answered_total += 1
+            elif p.done and p.error is not None:
+                self.failed_total += 1
         agg_update(self._latency_agg, ms)
+        self._window_ms.extend(ms)
         return ms
+
+    def _latency_sections(self) -> dict:
+        """The serve record's latency fields — ONE implementation for
+        the single-engine and router emitters (the window accumulates
+        across drains, so the `request_latency_ms` shape stays exactly
+        what it was before histograms existed)."""
+        self._drain_latencies()
+        window, self._window_ms = self._window_ms, []
+        fields = {}
+        if window:
+            fields['request_latency_ms'] = window_stats(window)
+        if self.latency_hist:
+            fields['latency_hist'] = {
+                b: h.snapshot()
+                for b, h in sorted(self.latency_hist.items())}
+        return fields
+
+    def slo_snapshot(self) -> dict:
+        """Cumulative availability counters + mergeable histograms —
+        the host's contribution to the fleet `slo` record (shipped in
+        the stats RPC)."""
+        self._drain_latencies()
+        return dict(
+            answered=self.answered_total,
+            failed=self.failed_total,
+            latency_hist={b: h.snapshot()
+                          for b, h in sorted(self.latency_hist.items())})
 
     def _emit(self, kind: str, fields: dict) -> dict:
         if kind == 'serve':
@@ -160,9 +210,7 @@ class ServeTelemetry(ServeTelemetryBase):
             runtime=runtime,
             post_warmup_compiles=self.post_warmup_compiles,
         )
-        latencies = self._drain_latencies()
-        if latencies:
-            fields['request_latency_ms'] = window_stats(latencies)
+        fields.update(self._latency_sections())
         return self._emit('serve', fields)
 
     def close(self) -> dict:

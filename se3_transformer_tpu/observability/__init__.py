@@ -33,6 +33,19 @@ Two attribution pillars joined in PR 6:
     with coverage + roofline utilization. Supersedes the ad-hoc
     trace_summary/stage_timings script pair.
 
+Two fleet pillars joined in PR 16:
+
+  * `tracing`  — request tracing across the serving fleet: `Tracer`
+    records spans (admit/queue_wait/batch_fill/dispatch/device_run/
+    retry/redispatch/probe/rollout) under globally-unique trace ids
+    minted at `FleetRouter.submit`; span-tree analysis + the
+    completeness invariant land in the schema'd `trace` record.
+  * `slo`      — mergeable fixed-boundary latency histograms (merged
+    fleet percentiles exact by construction) + `SLOAggregator`, which
+    folds heartbeat-scraped host stats into the schema'd `slo` record
+    (availability, error-budget burn, breaker dwell, rollouts).
+    CLI: `scripts/slo_report.py`; gate: `make slo-smoke`.
+
 `schema` holds the record contract both producers and the validator
 share (`make obs-smoke` gates on it).
 """
@@ -57,4 +70,12 @@ from .costs import (  # noqa: F401
 )
 from .profiling import (  # noqa: F401
     capture_step_profile, profile_payload,
+)
+from .tracing import (  # noqa: F401
+    Tracer, complete_request_trees, multi_host_traces, orphan_spans,
+    span_trees, trace_record_body,
+)
+from .slo import (  # noqa: F401
+    LatencyHistogram, SLOAggregator, histogram_percentiles,
+    merge_histograms,
 )

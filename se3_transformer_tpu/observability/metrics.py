@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import uuid
 from typing import Dict, Iterable, Optional
@@ -238,20 +239,28 @@ class MetricLogger:
                         exist_ok=True)
         self._fh = open(path, 'a') if path else None
         self._t0 = time.time()
+        # reentrant: _ensure_meta writes the header while already inside
+        # the locked region. Multiple serve-loop threads may share one
+        # logger (the in-process fleet smokes do) — without the lock the
+        # lazy run_meta header loses the race and a serve record lands
+        # first, which validate_stream rejects.
+        self._lock = threading.RLock()
 
     # -- plumbing -------------------------------------------------------- #
     def _write(self, rec: dict):
-        if self._fh:
-            self._fh.write(json.dumps(rec) + '\n')
-            self._fh.flush()
+        with self._lock:
+            if self._fh:
+                self._fh.write(json.dumps(rec) + '\n')
+                self._fh.flush()
 
     def _ensure_meta(self):
-        if self._meta_written:
-            return
-        self._meta_written = True
-        meta = collect_run_meta(self._extra_meta)
-        meta['run_id'] = self.run_id
-        self._write(meta)
+        with self._lock:
+            if self._meta_written:
+                return
+            meta = collect_run_meta(self._extra_meta)
+            meta['run_id'] = self.run_id
+            self._write(meta)
+            self._meta_written = True
         if self.mirror:
             self.mirror(f'run {self.run_id} backend={meta.get("backend")} '
                         f'code_rev={meta.get("code_rev")}')

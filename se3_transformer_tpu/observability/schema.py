@@ -151,6 +151,37 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    ran, dense_step_ms + dense_vs_so2 + parity_l2}).
                    `make so2-smoke` gates on it and PERF_BUDGETS.json
                    enforces the degree-4 win + throughput floor.
+  trace            fleet-wide request-tracing evidence for one run
+                   (observability.tracing.trace_record_body, exercised
+                   by scripts/slo_smoke.py and the chaos smokes):
+                   traces (request span trees observed) +
+                   complete_trees, spans_total + spans_by_name
+                   (per-name {count, total_ms, exclusive_ms} — the
+                   exclusive figures come from the per-thread
+                   interval-stack idiom, so nested spans never
+                   double-count), retry_hops / redispatch_hops (must
+                   reconcile with the Router/FleetRouter retry
+                   counters), multi_host_traces (traces whose spans
+                   touched >= 2 hosts — cross-host redispatch made
+                   visible), and the load-bearing pair: orphan_spans
+                   (spans whose parent never appears in their trace —
+                   MUST be 0) + completeness_total (fraction of
+                   answered-or-structured-failed requests with exactly
+                   one single-root span tree — MUST be 1.0; `make
+                   slo-smoke` and obs_report --require trace gate it).
+  slo              fleet SLO aggregation for one run
+                   (observability.slo.SLOAggregator.record_body,
+                   scraped over FleetRouter heartbeats): hosts folded,
+                   availability (answered / (answered + failures) —
+                   the load-bearing field, budgeted by
+                   fleet_availability_floor), answered /
+                   request_failures / timeouts, buckets (per-bucket
+                   fleet p50/p95/p99 off MERGED fixed-boundary
+                   histograms — exact by construction, never averaged
+                   percentiles), error_budget ({target, budget,
+                   burn_rate}), breaker_dwell (per-host seconds in
+                   each breaker state off the transition log), and the
+                   rollout/rollback history.
   summary          end-of-run cumulative record (metrics, timing,
                    nodes_steps_per_sec, loss trajectory,
                    retrace_warnings_total).
@@ -168,7 +199,8 @@ SCHEMA_VERSION = 1
 
 KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
                'serve', 'tune', 'comm', 'cost', 'profile', 'so2_sweep',
-               'flash', 'fault', 'guard', 'fleet', 'quant_ab', 'summary')
+               'flash', 'fault', 'guard', 'fleet', 'quant_ab', 'trace',
+               'slo', 'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
@@ -232,6 +264,22 @@ _REQUIRED = {
     'quant_ab': ('run_id', 'label', 'mix', 'buckets',
                  'argument_bytes_ratio', 'parity_max_abs',
                  'quant_error_max_abs', 'equivariance_l2'),
+    # orphan_spans + completeness_total are the load-bearing pair of
+    # the tracing contract: a trace record that cannot say whether
+    # every answered-or-structured-failed request produced exactly one
+    # single-root span tree proves nothing about end-to-end visibility
+    'trace': ('run_id', 'label', 'traces', 'complete_trees',
+              'orphan_spans', 'spans_total', 'spans_by_name',
+              'retry_hops', 'redispatch_hops', 'multi_host_traces',
+              'completeness_total'),
+    # availability is the load-bearing field of the SLO contract: an
+    # slo record that cannot say what fraction of requests the fleet
+    # answered proves nothing about "millions of users" — and its
+    # bucket percentiles must come from merged histograms, never
+    # averaged per-host percentiles
+    'slo': ('run_id', 'label', 'hosts', 'availability', 'answered',
+            'request_failures', 'timeouts', 'buckets', 'error_budget',
+            'breaker_dwell', 'rollouts'),
     # equivariance_l2_so2 per degree is the load-bearing field of the
     # backend contract: a sweep record that cannot say the reduced
     # contraction is still equivariant proves nothing about the speedup
@@ -277,6 +325,36 @@ class SchemaError(ValueError):
 def _fail(index, msg):
     where = f'record {index}: ' if index is not None else ''
     raise SchemaError(where + msg)
+
+
+def _validate_latency_hist(hist, index, where):
+    """One mergeable-histogram section: bucket -> {bounds, counts,
+    count}. Counts must have one more slot than bounds (the overflow
+    bucket) and sum to count — a snapshot that cannot merge exactly is
+    worse than no snapshot."""
+    if not isinstance(hist, dict):
+        _fail(index, f'{where}.latency_hist must be an object '
+                     f'(bucket -> histogram snapshot)')
+    for bucket, snap in hist.items():
+        if not isinstance(snap, dict):
+            _fail(index, f'{where}.latency_hist[{bucket!r}] must be an '
+                         f'object')
+        bounds, counts = snap.get('bounds'), snap.get('counts')
+        if not isinstance(bounds, list) or not isinstance(counts, list) \
+                or len(counts) != len(bounds) + 1:
+            _fail(index, f'{where}.latency_hist[{bucket!r}] must carry '
+                         f'bounds plus len(bounds)+1 counts (the last '
+                         f'slot is the overflow bucket)')
+        total = snap.get('count')
+        if not isinstance(total, int) or isinstance(total, bool) \
+                or total < 0:
+            _fail(index, f'{where}.latency_hist[{bucket!r}].count must '
+                         f'be a non-negative int, got {total!r}')
+        if sum(counts) != total:
+            _fail(index, f'{where}.latency_hist[{bucket!r}].count='
+                         f'{total} contradicts counts summing to '
+                         f'{sum(counts)} — the snapshot cannot merge '
+                         f'exactly')
 
 
 def validate_record(rec: dict, index=None) -> dict:
@@ -381,6 +459,12 @@ def validate_record(rec: dict, index=None) -> dict:
                         or snap.get('state') not in _HEALTH_STATES:
                     _fail(index, f'serve.health[{rid!r}] must carry a '
                                  f'state in {_HEALTH_STATES}')
+        # mergeable per-bucket latency histograms (observability.slo):
+        # optional but validated when present — the fleet SLO
+        # aggregation merges these by count addition, so a malformed
+        # snapshot poisons the fleet percentiles
+        if 'latency_hist' in rec:
+            _validate_latency_hist(rec['latency_hist'], index, 'serve')
     if kind == 'fault':
         for field in ('injections', 'health_transitions'):
             if not isinstance(rec[field], list):
@@ -572,6 +656,76 @@ def validate_record(rec: dict, index=None) -> dict:
                     or val < 0:
                 _fail(index, f'quant_ab.{field} must be a non-negative '
                              f'number, got {val!r}')
+    if kind == 'trace':
+        for field in ('traces', 'complete_trees', 'orphan_spans',
+                      'spans_total', 'retry_hops', 'redispatch_hops',
+                      'multi_host_traces'):
+            val = rec[field]
+            if not isinstance(val, int) or isinstance(val, bool) \
+                    or val < 0:
+                _fail(index, f'trace.{field} must be a non-negative '
+                             f'int, got {val!r}')
+        comp = rec['completeness_total']
+        if not isinstance(comp, (int, float)) or isinstance(comp, bool) \
+                or not 0 <= comp <= 1:
+            _fail(index, f'trace.completeness_total must be a number in '
+                         f'[0, 1], got {comp!r}')
+        if rec['complete_trees'] > rec['traces']:
+            _fail(index, f'trace.complete_trees={rec["complete_trees"]} '
+                         f'exceeds traces={rec["traces"]}')
+        if rec['orphan_spans'] > 0 and rec['traces'] > 0 and comp >= 1.0:
+            _fail(index, f'trace.completeness_total={comp} contradicts '
+                         f'{rec["orphan_spans"]} orphan spans — an '
+                         f'orphaned span means some tree is incomplete')
+        by_name = rec['spans_by_name']
+        if not isinstance(by_name, dict):
+            _fail(index, 'trace.spans_by_name must be an object '
+                         '(span name -> exclusive-duration entry)')
+        for name, entry in by_name.items():
+            missing = [k for k in ('count', 'total_ms', 'exclusive_ms')
+                       if not isinstance(entry, dict) or k not in entry]
+            if missing:
+                _fail(index, f'trace.spans_by_name[{name!r}] missing '
+                             f'{missing} (exclusive durations are the '
+                             f'whole attribution)')
+    if kind == 'slo':
+        for field in ('hosts', 'answered', 'request_failures',
+                      'timeouts'):
+            val = rec[field]
+            if not isinstance(val, int) or isinstance(val, bool) \
+                    or val < 0:
+                _fail(index, f'slo.{field} must be a non-negative int, '
+                             f'got {val!r}')
+        avail = rec['availability']
+        if not isinstance(avail, (int, float)) \
+                or isinstance(avail, bool) or not 0 <= avail <= 1:
+            _fail(index, f'slo.availability must be a number in [0, 1], '
+                         f'got {avail!r}')
+        buckets = rec['buckets']
+        if not isinstance(buckets, dict):
+            _fail(index, 'slo.buckets must be an object (bucket -> '
+                         'merged fleet percentiles)')
+        for bucket, st in buckets.items():
+            missing = [k for k in ('count', 'p50_ms', 'p95_ms', 'p99_ms')
+                       if not isinstance(st, dict) or k not in st]
+            if missing:
+                _fail(index, f'slo.buckets[{bucket!r}] missing {missing} '
+                             f'(merged fleet percentiles are the whole '
+                             f'point)')
+        budget = rec['error_budget']
+        if not isinstance(budget, dict) or 'target' not in budget \
+                or 'burn_rate' not in budget:
+            _fail(index, f'slo.error_budget must carry target and '
+                         f'burn_rate, got {budget!r}')
+        if not isinstance(rec['breaker_dwell'], dict):
+            _fail(index, 'slo.breaker_dwell must be an object '
+                         '(host -> per-state seconds)')
+        rollouts = rec['rollouts']
+        if not isinstance(rollouts, dict) \
+                or not isinstance(rollouts.get('count'), int) \
+                or not isinstance(rollouts.get('rollbacks'), int):
+            _fail(index, f'slo.rollouts must carry int count and '
+                         f'rollbacks, got {rollouts!r}')
     if kind == 'so2_sweep':
         degrees = rec['degrees']
         if not isinstance(degrees, dict) or not degrees:

@@ -1,0 +1,278 @@
+"""Fleet-wide request tracing: spans, span trees, and the completeness
+invariant behind the schema'd `trace` record.
+
+One request traverses FleetRouter -> host RPC -> Router ->
+ContinuousBatcher -> ReplicaWorker dispatch -> InferenceEngine.run,
+possibly redispatching across hosts. Each tier records spans into a
+`Tracer` (one per process): the fleet front-end mints the trace id and
+the single root `request` span at submit; every RPC attempt carries the
+trace context in the payload (`{'trace': <id>, 'parent': <span id>}`),
+the host side hangs its `admit` / `queue_wait` / `batch_fill` /
+`dispatch` / `device_run` / `retry` spans under that parent, and the
+finished host-side spans ride back to the front-end inside the infer
+response (`spans` key), where they fold into the fleet tracer. A host
+that dies mid-request simply loses its local spans — the fleet-side
+tree (root + `attempt` + `redispatch`) stays complete through the retry
+path, which is exactly the zero-orphan-under-SIGKILL property the
+chaos gates assert.
+
+Identifiers are globally unique by construction: every Tracer derives a
+per-process uniq token (origin + pid + random), trace ids are
+`req-<uniq>-<n>` (control-plane actions — probes, rollouts — mint
+`ctl-<uniq>-<n>` and are excluded from request-completeness
+accounting), span ids are `s-<uniq>-<n>`.
+
+The completeness invariant (`trace_record_body`): every answered OR
+structured-failed request yields exactly ONE single-root span tree with
+zero orphans (an orphan is a span whose parent id never appears in its
+trace). `completeness_total` is the fraction of request traces that
+satisfy it — 1.0 is the contract, anything less means instrumentation
+lost a request's story. Exclusive durations per span name come from the
+PR 6 per-thread interval-stack idiom (`profiling.exclusive_durations`),
+grouped per (trace, recording process) so spans from different clock
+domains never subtract across hosts.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from .profiling import exclusive_durations
+
+# trace-id kind prefixes: request traces participate in the
+# completeness invariant; control-plane traces (probe / rollout) are
+# operator actions with no submitting request to reconcile against
+REQUEST_KIND = 'req'
+CONTROL_KIND = 'ctl'
+
+_UNSET = object()
+
+
+class Tracer:
+    """Thread-safe span recorder for ONE process.
+
+    Spans are JSON-safe dicts::
+
+        {trace, span, parent, name, org, host, ts, dur_ms, ...meta}
+
+    `begin()`/`end()` bracket an interval (end is idempotent — terminal
+    sites may race); `add()` records an already-timed or instantaneous
+    span; `extend()` folds spans recorded by another Tracer (e.g.
+    returned in an RPC response). `host` stamps every span so
+    cross-host traces are readable from the record alone.
+    """
+
+    def __init__(self, origin: str = 'fleet', host=None,
+                 capacity: int = 65536, clock=time.monotonic):
+        self.origin = str(origin)
+        self.host = host
+        self.clock = clock
+        self.dropped = 0
+        self._capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._spans: List[dict] = []
+        self._seq = 0
+        self._uniq = (f'{self.origin}-{os.getpid():x}-'
+                      f'{uuid.uuid4().hex[:6]}')
+
+    # ---- id minting -------------------------------------------------- #
+    def _next(self, prefix: str) -> str:
+        with self._lock:
+            n = self._seq
+            self._seq += 1
+        return f'{prefix}{self._uniq}-{n}'
+
+    def mint(self, kind: str = REQUEST_KIND) -> str:
+        """A new globally-unique trace id (`req-...` or `ctl-...`)."""
+        return self._next(f'{kind}-')
+
+    # ---- recording --------------------------------------------------- #
+    def begin(self, trace_id: str, name: str, parent_id=None,
+              host=_UNSET, **meta) -> dict:
+        """Open a span; it is NOT recorded until `end()` lands it."""
+        span = dict(trace=trace_id, span=self._next('s-'),
+                    parent=parent_id, name=str(name), org=self._uniq,
+                    host=self.host if host is _UNSET else host,
+                    ts=self.clock(), dur_ms=None)
+        if meta:
+            span.update(meta)
+        return span
+
+    def end(self, span: Optional[dict], **meta) -> Optional[dict]:
+        """Close and record a `begin()` span. Idempotent: the first
+        terminal site wins, later calls are no-ops."""
+        if span is None or span.get('dur_ms') is not None:
+            return span
+        span['dur_ms'] = round(
+            max(self.clock() - span['ts'], 0.0) * 1e3, 3)
+        span['ts'] = round(float(span['ts']), 6)
+        if meta:
+            span.update(meta)
+        self._record(span)
+        return span
+
+    def add(self, trace_id: str, name: str, *, parent_id=None,
+            ts=None, dur_ms: float = 0.0, host=_UNSET, **meta) -> dict:
+        """Record an already-timed (or instantaneous) span."""
+        span = dict(trace=trace_id, span=self._next('s-'),
+                    parent=parent_id, name=str(name), org=self._uniq,
+                    host=self.host if host is _UNSET else host,
+                    ts=round(float(self.clock() if ts is None else ts),
+                             6),
+                    dur_ms=round(max(float(dur_ms), 0.0), 3))
+        if meta:
+            span.update(meta)
+        self._record(span)
+        return span
+
+    def extend(self, spans) -> None:
+        """Fold closed spans recorded elsewhere into this recorder."""
+        for s in spans or []:
+            if isinstance(s, dict) and s.get('dur_ms') is not None:
+                self._record(dict(s))
+
+    def _record(self, span: dict) -> None:
+        with self._lock:
+            if len(self._spans) < self._capacity:
+                self._spans.append(span)
+            else:
+                self.dropped += 1
+
+    # ---- reading ----------------------------------------------------- #
+    @property
+    def spans(self) -> List[dict]:
+        with self._lock:
+            return list(self._spans)
+
+    def pop_trace(self, trace_id: str) -> List[dict]:
+        """Remove and return every recorded span of one trace — the
+        host side ships them back in the infer response with this."""
+        with self._lock:
+            keep, out = [], []
+            for s in self._spans:
+                (out if s.get('trace') == trace_id else keep).append(s)
+            self._spans = keep
+        return out
+
+
+# --------------------------------------------------------------------- #
+# span-tree analysis
+# --------------------------------------------------------------------- #
+def span_trees(spans) -> Dict[str, List[dict]]:
+    """Group spans by trace id."""
+    trees: Dict[str, List[dict]] = {}
+    for s in spans:
+        trees.setdefault(s.get('trace'), []).append(s)
+    return trees
+
+
+def orphan_spans(spans) -> List[dict]:
+    """Spans whose parent id never appears inside their own trace."""
+    out = []
+    for group in span_trees(spans).values():
+        ids = {s.get('span') for s in group}
+        out += [s for s in group
+                if s.get('parent') and s['parent'] not in ids]
+    return out
+
+
+def complete_request_trees(spans) -> List[str]:
+    """Request-trace ids whose tree is exactly one root (parent None)
+    with zero orphans — the per-request completeness invariant."""
+    done = []
+    for tid, group in span_trees(spans).items():
+        if not (isinstance(tid, str)
+                and tid.startswith(REQUEST_KIND + '-')):
+            continue
+        ids = {s.get('span') for s in group}
+        roots = [s for s in group if not s.get('parent')]
+        orphans = [s for s in group
+                   if s.get('parent') and s['parent'] not in ids]
+        if len(roots) == 1 and not orphans:
+            done.append(tid)
+    return done
+
+
+def exclusive_by_name(spans) -> Dict[str, dict]:
+    """Per-span-name {count, total_ms, exclusive_ms}.
+
+    Exclusive time comes from the per-thread interval-stack idiom
+    (PR 6 `profiling.exclusive_durations`): spans map to trace events
+    keyed (pid=trace, tid=recording process), so nesting is computed
+    only within one clock domain — a host's `device_run` subtracts from
+    its `dispatch`, never from the fleet's `attempt` (different
+    monotonic clocks are not comparable)."""
+    events = [dict(name=s.get('name'), pid=s.get('trace'),
+                   tid=s.get('org'),
+                   ts=float(s.get('ts') or 0.0) * 1e6,
+                   dur=float(s.get('dur_ms') or 0.0) * 1e3)
+              for s in spans if s.get('dur_ms') is not None]
+    acc: Dict[str, dict] = {}
+    for ev, excl in exclusive_durations(events):
+        e = acc.setdefault(ev['name'],
+                           dict(count=0, total_ms=0.0, exclusive_ms=0.0))
+        e['count'] += 1
+        e['total_ms'] += ev['dur'] / 1e3
+        e['exclusive_ms'] += excl / 1e3
+    return {name: dict(count=e['count'],
+                       total_ms=round(e['total_ms'], 3),
+                       exclusive_ms=round(e['exclusive_ms'], 3))
+            for name, e in sorted(acc.items())}
+
+
+def multi_host_traces(spans) -> int:
+    """Request traces whose spans touched >= 2 distinct hosts — the
+    cross-host-redispatch visibility proof."""
+    n = 0
+    for tid, group in span_trees(spans).items():
+        if not (isinstance(tid, str)
+                and tid.startswith(REQUEST_KIND + '-')):
+            continue
+        hosts = {s.get('host') for s in group
+                 if s.get('host') is not None}
+        if len(hosts) >= 2:
+            n += 1
+    return n
+
+
+def trace_record_body(tracer, label: str = 'trace',
+                      expected: Optional[int] = None) -> dict:
+    """Assemble the schema'd `trace` record fields from a Tracer (or a
+    raw span list).
+
+    `expected` is the number of requests that resolved answered OR
+    structured-failed — when given, `completeness_total` is judged
+    against max(expected, observed request traces), so a request that
+    never produced a root span (instrumentation loss) still lowers the
+    score."""
+    spans = tracer.spans if isinstance(tracer, Tracer) else list(tracer)
+    trees = span_trees(spans)
+    req_traces = [t for t in trees
+                  if isinstance(t, str)
+                  and t.startswith(REQUEST_KIND + '-')]
+    complete = complete_request_trees(spans)
+    orphans = orphan_spans(spans)
+    denom = max(len(req_traces),
+                int(expected) if expected is not None else 0)
+    completeness = 1.0 if denom == 0 else len(complete) / denom
+    body = dict(
+        label=label,
+        traces=len(req_traces),
+        complete_trees=len(complete),
+        orphan_spans=len(orphans),
+        spans_total=len(spans),
+        spans_by_name=exclusive_by_name(spans),
+        retry_hops=sum(1 for s in spans if s.get('name') == 'retry'),
+        redispatch_hops=sum(1 for s in spans
+                            if s.get('name') == 'redispatch'),
+        multi_host_traces=multi_host_traces(spans),
+        completeness_total=round(completeness, 6),
+    )
+    if expected is not None:
+        body['expected_traces'] = int(expected)
+    if isinstance(tracer, Tracer) and tracer.dropped:
+        body['dropped_spans'] = tracer.dropped
+    return body

@@ -63,6 +63,7 @@ from ..inference.admission import (
     oversize_error, retries_exhausted_error,
 )
 from ..inference.batching import PendingResult
+from ..observability.tracing import CONTROL_KIND, Tracer
 from .health import QUARANTINED, HealthConfig, HealthMonitor
 from .router import Router
 from .transport import TransportError
@@ -123,6 +124,16 @@ class HostServer:
         self.default_timeout_s = float(default_timeout_s)
         self.flush_every_batches = int(flush_every_batches)
         self.on_swap = on_swap
+        # host-side request tracing: spans are only recorded for
+        # requests whose RPC payload carries a trace context, so an
+        # untraced fleet pays nothing. The router's id namespace gets
+        # the host prefix here too — per-router monotonic ints collide
+        # across hosts once record streams merge.
+        self.tracer = Tracer(origin=f'host{self.host_id}',
+                             host=self.host_id, clock=clock)
+        router.attach_tracer(self.tracer)
+        if router.id_prefix is None:
+            router.id_prefix = f'h{self.host_id}'
         self.started_at = clock()
         self.calls: Dict[str, int] = {m: 0 for m in self.METHODS}
         # handle() runs on arbitrary transport threads (one per socket
@@ -271,7 +282,8 @@ class HostServer:
                 coords = np.asarray(payload['coords'],
                                     np.float32).reshape(-1, 3)
                 pending = self.router.submit(
-                    tokens, coords, timeout_s=payload.get('timeout_s'))
+                    tokens, coords, timeout_s=payload.get('timeout_s'),
+                    trace=payload.get('trace'))
             except RequestRejected as e:
                 call.respond(dict(ok=False, error=dict(
                     code=e.code, message=str(e), detail=e.detail)))
@@ -285,15 +297,27 @@ class HostServer:
 
     def _infer_response(self, p: PendingResult) -> dict:
         if p.ok:
-            return dict(ok=True,
+            resp = dict(ok=True,
                         result=np.asarray(p.result).tolist(),
                         latency_ms=round((p.latency_s or 0.0) * 1e3, 3))
-        err = p.error
-        if isinstance(err, (RequestFailed, RequestRejected)):
-            return dict(ok=False, error=dict(
-                code=err.code, message=str(err), detail=err.detail))
-        return dict(ok=False, error=dict(
-            code='internal', message=f'{type(err).__name__}: {err}'))
+        else:
+            err = p.error
+            if isinstance(err, (RequestFailed, RequestRejected)):
+                resp = dict(ok=False, error=dict(
+                    code=err.code, message=str(err), detail=err.detail))
+            else:
+                resp = dict(ok=False, error=dict(
+                    code='internal',
+                    message=f'{type(err).__name__}: {err}'))
+        tr = getattr(p, 'trace', None)
+        if tr:
+            # ship the request's host-side spans back to the fleet
+            # front-end (error verdicts carry their story too); popping
+            # keeps the host tracer bounded by what is still in flight
+            spans = self.tracer.pop_trace(tr['ctx'])
+            if spans:
+                resp['spans'] = spans
+        return resp
 
     def _stats_body(self, now: float) -> dict:
         """The per-host routing signal, scraped off the surfaces that
@@ -306,10 +330,15 @@ class HostServer:
         p99 = {phase[len('bucket_'):]: st.get('p99_ms')
                for phase, st in cum.items() if phase.startswith('bucket_')}
         post_warmup = None
+        slo = None
         if self.telemetry is not None:
             self.telemetry._check_runtime()     # fold in compile deltas
             post_warmup = self.telemetry.post_warmup_compiles
-        return dict(
+            # mergeable per-bucket latency histograms + cumulative
+            # answered/failed: the fleet's SLOAggregator folds these,
+            # so fleet percentiles are EXACT merges, never averaged
+            slo = self.telemetry.slo_snapshot()
+        body = dict(
             host=self.host_id, t=round(now, 4),
             buckets=list(r.buckets),
             queue_depth=r.queue_depth,
@@ -329,6 +358,9 @@ class HostServer:
             health=r.health.snapshot(),
             post_warmup_compiles=post_warmup,
         )
+        if slo is not None:
+            body.update(slo)
+        return body
 
     def _maybe_flush(self):
         if self.telemetry is None:
@@ -383,7 +415,9 @@ class FleetRouter:
                  heartbeat_every_s: float = 0.5,
                  heartbeat_timeout_s: float = 2.0,
                  stale_after_s: float = 5.0,
-                 concurrency: int = 8):
+                 concurrency: int = 8,
+                 tracer: Optional[Tracer] = None,
+                 slo=None):
         if isinstance(transports, dict):
             items = sorted(transports.items())
         else:
@@ -401,6 +435,12 @@ class FleetRouter:
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.stale_after_s = float(stale_after_s)
         self.host_exclusion = True      # the chaos weaken hook
+        # observability plane (both optional, both zero-cost when
+        # absent): `tracer` mints one trace per submit and folds the
+        # hosts' returned spans; `slo` (observability.slo.SLOAggregator)
+        # is fed every successful stats scrape
+        self.tracer = tracer
+        self.slo = slo
         self.buckets: Optional[tuple] = None   # learned from scrapes
         self._lock = threading.Lock()
         self._executor = ThreadPoolExecutor(
@@ -461,6 +501,13 @@ class FleetRouter:
         pending.completed_at = self.clock()
         with self._lock:
             self.request_failures += 1
+        tr = getattr(pending, 'trace', None)
+        if self.tracer is not None and tr:
+            # a structured failure still closes the root span — the
+            # completeness invariant covers failed requests too
+            self.tracer.end(tr['root'],
+                            status=getattr(error, 'code', None)
+                            or type(error).__name__)
 
     # ------------------------------------------------------------------ #
     # placement
@@ -531,6 +578,15 @@ class FleetRouter:
             self.submitted += 1
         pending = PendingResult(rid, length, bucket, submitted_at,
                                 deadline=deadline)
+        if self.tracer is not None:
+            # the single trace root: every span of this request — fleet
+            # attempts, redispatches, and the hosts' returned admit/
+            # dispatch trees — hangs under it, and exactly one terminal
+            # site closes it (end() is idempotent)
+            tid = self.tracer.mint()
+            root = self.tracer.begin(tid, 'request', rid=rid,
+                                     pinned=pin_host)
+            pending.trace = dict(ctx=tid, root=root)
         self._track(self._executor.submit(
             self._dispatch, pending, tokens, coords, pin_host))
         return pending
@@ -584,6 +640,15 @@ class FleetRouter:
                     return
                 with self._lock:
                     self.cross_host_retries += 1
+                tr = getattr(pending, 'trace', None)
+                if self.tracer is not None and tr:
+                    # one redispatch span per cross_host_retries
+                    # increment — the trace record's redispatch_hops
+                    # reconciles against the counter exactly
+                    self.tracer.add(tr['ctx'], 'redispatch',
+                                    parent_id=tr['root']['span'],
+                                    failed_host=host.id,
+                                    attempt=pending.attempts)
         except Exception as e:   # defense in depth: a bug here must
             #                      still resolve the request, not lose it
             if not pending.done:
@@ -605,6 +670,17 @@ class FleetRouter:
             remaining = max(0.0, pending.deadline - now)
             payload['timeout_s'] = round(remaining, 4)
             rpc_timeout = remaining + 5.0
+        att = None
+        tr = getattr(pending, 'trace', None)
+        if self.tracer is not None and tr:
+            att = self.tracer.begin(tr['ctx'], 'attempt',
+                                    parent_id=tr['root']['span'],
+                                    host=host.id)
+            # the trace context rides the payload (the transport is
+            # payload-opaque); the host hangs its spans under `parent`
+            # and ships them back in the response's `spans` key
+            payload['trace'] = dict(trace=tr['ctx'],
+                                    parent=att['span'])
         with self._lock:
             host.outstanding += 1
         try:
@@ -613,10 +689,20 @@ class FleetRouter:
         except TransportError as e:
             host.last_error = str(e)
             self.health.record_failure(host.id, e)
+            if self.tracer is not None:
+                # the host (or its link) died mid-RPC: its local spans
+                # are simply lost — the fleet-side tree stays complete
+                # through this attempt span and the retry path
+                self.tracer.end(att, status='transport_error')
             return 'failed', e
         finally:
             with self._lock:
                 host.outstanding -= 1
+        if self.tracer is not None and att is not None:
+            self.tracer.end(att, status=('ok' if res.get('ok') else
+                                         (res.get('error') or {})
+                                         .get('code')))
+            self.tracer.extend(res.get('spans'))
         if res.get('ok'):
             self.health.record_success(host.id)
             pending.result = np.asarray(res['result'], np.float32)
@@ -624,6 +710,8 @@ class FleetRouter:
             pending.completed_at = self.clock()
             with self._lock:
                 self.answered += 1
+            if self.tracer is not None and tr:
+                self.tracer.end(tr['root'], status='ok')
             return 'answered', None
         err = (res.get('error') or {})
         code = err.get('code')
@@ -707,6 +795,10 @@ class FleetRouter:
             h.stats = res.get('stats') or {}
             h.last_ok_at = self.clock()
             h.last_stale_mark = None
+            if self.slo is not None:
+                # the heartbeat loop IS the SLO scrape: stats carry the
+                # host's cumulative mergeable histograms and counters
+                self.slo.fold(h.id, h.stats)
             with self._lock:
                 self.heartbeats_ok += 1
                 if self.buckets is None and h.stats.get('buckets'):
@@ -724,14 +816,24 @@ class FleetRouter:
         rotation and dispatch successes walk it to healthy; failure
         doubles the backoff. A restarted process on the same port
         recovers through exactly this path."""
+        span = None
+        if self.tracer is not None:
+            # control-plane trace: excluded from request completeness
+            span = self.tracer.begin(
+                self.tracer.mint(CONTROL_KIND), 'probe', host=h.id)
         try:
             res = h.transport.call('ping',
                                    timeout_s=self.heartbeat_timeout_s)
         except TransportError as e:
             h.last_error = str(e)
             self.health.record_failure(h.id, e)
+            if self.tracer is not None:
+                self.tracer.end(span, status='transport_error')
             return
-        if res.get('ok'):
+        ok = bool(res.get('ok'))
+        if self.tracer is not None:
+            self.tracer.end(span, status='ok' if ok else 'error')
+        if ok:
             self.health.record_success(h.id)
             h.last_ok_at = self.clock()
             h.last_stale_mark = None
@@ -783,6 +885,12 @@ class FleetRouter:
         canary_host = (self.hosts[int(canary)] if canary is not None
                        else min(pool, key=self._score))
         pre = self._scrape_sync(canary_host)
+        span = None
+        if self.tracer is not None:
+            # control-plane trace over the whole canary decision
+            span = self.tracer.begin(
+                self.tracer.mint(CONTROL_KIND), 'rollout',
+                canary=canary_host.id)
         event = dict(t=round(self.clock(), 3), canary=canary_host.id,
                      new=dict(new_ref))
         try:
@@ -793,6 +901,8 @@ class FleetRouter:
                          aborted=f'canary swap failed: {e}')
             with self._lock:
                 self.rollout_events.append(event)
+            if self.tracer is not None:
+                self.tracer.end(span, status='aborted')
             return event, []
         # the probes ride the SAME admission path as every request
         # (oversize gate included), just pinned single-attempt
@@ -853,6 +963,9 @@ class FleetRouter:
                     self.rollbacks += 1
         with self._lock:
             self.rollout_events.append(event)
+        if self.tracer is not None:
+            self.tracer.end(
+                span, status='passed' if passed else 'rolled_back')
         return event, probes
 
     def _scrape_sync(self, h: _HostHandle) -> Optional[dict]:
@@ -864,8 +977,18 @@ class FleetRouter:
         if res.get('ok'):
             h.stats = res.get('stats') or {}
             h.last_ok_at = self.clock()
+            if self.slo is not None:
+                self.slo.fold(h.id, h.stats)
             return h.stats
         return None
+
+    def scrape(self) -> int:
+        """Synchronously scrape every host's stats ONCE (fold into the
+        SLO aggregator when attached) — the end-of-run flush a smoke
+        uses so the final `slo` record reflects the hosts' cumulative
+        counters, not the last heartbeat's. Returns hosts scraped."""
+        return sum(1 for h in self.hosts.values()
+                   if self._scrape_sync(h) is not None)
 
     def _wait_for(self, probes: Sequence[PendingResult],
                   timeout_s: float = 120.0):

@@ -110,6 +110,10 @@ class ContinuousBatcher:
         # carry the same machine-readable retry_after_s backoff hint
         # overload sheds do — clients back off uniformly
         self.retry_hint: Optional[Callable[[int], float]] = None
+        # request tracing (observability.tracing.Tracer; Router wires
+        # it): batch_fill spans here, queue_wait/dispatch/device_run
+        # inside dispatch_batch. None = span-free, zero overhead.
+        self.tracer = None
         # per-request deadline accounting: requests resolved with a
         # structured RequestFailed('deadline') — shed at dispatch time
         # (deadline_sheds) or expired while waiting in an open slot
@@ -159,6 +163,14 @@ class ContinuousBatcher:
             slot = self._slots[bucket] = _Slot(bucket)
         elif slot.pending:
             self.continuous_admissions += 1
+            tr = getattr(pending, 'trace', None)
+            if self.tracer is not None and tr:
+                # the request joined an ALREADY-open in-flight slot —
+                # the continuous-batching event worth seeing per trace
+                self.tracer.add(tr['ctx'], 'batch_fill',
+                                parent_id=tr['parent'],
+                                bucket=int(bucket),
+                                fill=len(slot.pending) + 1)
         slot.tokens.append(np.asarray(tokens))
         slot.coords.append(np.asarray(coords, np.float32).reshape(-1, 3))
         slot.pending.append(pending)
@@ -306,7 +318,8 @@ class ContinuousBatcher:
                                slot.tokens, slot.coords, pending,
                                done_local, self._completed_capacity,
                                self.clock, on_success=self.on_success,
-                               on_failure=self.on_failure)
+                               on_failure=self.on_failure,
+                               tracer=self.tracer)
             finally:
                 with self._completed_lock:
                     self.completed.extend(done_local)

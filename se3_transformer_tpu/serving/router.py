@@ -106,6 +106,15 @@ class Router:
         self.admission = admission
         self.clock = clock
         self._next_id = 0
+        # request-id namespace: per-router ids are monotonic ints and
+        # COLLIDE once several routers' record streams merge — owners
+        # (HostServer) set id_prefix to a host component and ids become
+        # globally unique strings like 'h1-17' (tracing depends on it)
+        self.id_prefix: Optional[str] = None
+        # request tracing (observability.tracing.Tracer): attach_tracer
+        # fans it out to every replica batcher so admit/batch_fill/
+        # dispatch/device_run/retry spans share one recorder
+        self.tracer = None
         self.swap_events: List[dict] = []
         # ---- fault domain ------------------------------------------- #
         self.health = HealthMonitor([w.id for w in self.workers],
@@ -181,6 +190,15 @@ class Router:
 
     def bucket_for(self, length: int) -> Optional[int]:
         return fit_bucket(self.buckets, length)
+
+    def attach_tracer(self, tracer) -> None:
+        """Wire one span recorder through the router AND every replica
+        batcher — the whole host records into a single Tracer so
+        `pop_trace` can ship a request's full host-side story back in
+        the RPC response."""
+        self.tracer = tracer
+        for w in self.workers:
+            w.batcher.tracer = tracer
 
     def retry_after_hint(self, queue_depth: int) -> float:
         """Overload-shed backoff hint: queue depth x the per-request
@@ -263,6 +281,13 @@ class Router:
             else:
                 self.retries += 1
                 worker = self._pick_worker(exclude=failed_on)
+                tr = getattr(p, 'trace', None)
+                if self.tracer is not None and tr:
+                    self.tracer.add(tr['ctx'], 'retry',
+                                    parent_id=tr['parent'],
+                                    failed_on=failed_on,
+                                    replica=worker.id,
+                                    attempt=p.attempts)
                 worker.admit(p.bucket, tokens, coords, p)
                 redispatched += 1
         return redispatched
@@ -307,7 +332,8 @@ class Router:
         return min(routable, key=rank)
 
     def submit(self, tokens, coords,
-               timeout_s: Optional[float] = None) -> PendingResult:
+               timeout_s: Optional[float] = None,
+               trace: Optional[dict] = None) -> PendingResult:
         """Admit + place one request; its slot dispatches on fill.
 
         Raises RequestRejected (oversize / overloaded) without touching
@@ -315,7 +341,13 @@ class Router:
         accounting (same contract as MicroBatcher.submit).
         `timeout_s` (default: the router's `default_timeout_s`) stamps
         the request's deadline; the result then either answers in time
-        or resolves with a structured RequestFailed('deadline')."""
+        or resolves with a structured RequestFailed('deadline').
+
+        `trace` is an incoming trace context (`{'trace': <id>,
+        'parent': <span id>}` — the fleet RPC payload's `trace` key):
+        when present and a tracer is attached, an `admit` span lands
+        under the caller's parent and every downstream span of this
+        request hangs under the admit span."""
         tokens = np.asarray(tokens)
         length = len(tokens)
         bucket = self.bucket_for(length)
@@ -331,9 +363,19 @@ class Router:
                      else self.default_timeout_s)
         deadline = (submitted_at + float(timeout_s)
                     if timeout_s is not None else None)
-        pending = PendingResult(self._next_id, length, bucket,
+        rid = (self._next_id if self.id_prefix is None
+               else f'{self.id_prefix}-{self._next_id}')
+        pending = PendingResult(rid, length, bucket,
                                 submitted_at, deadline=deadline)
         self._next_id += 1
+        if self.tracer is not None and trace and trace.get('trace'):
+            admit = self.tracer.add(trace['trace'], 'admit',
+                                    parent_id=trace.get('parent'),
+                                    ts=submitted_at, rid=rid,
+                                    bucket=int(bucket),
+                                    replica=worker.id)
+            pending.trace = dict(ctx=trace['trace'],
+                                 parent=admit['span'])
         worker.admit(bucket, tokens, coords, pending)
         return pending
 

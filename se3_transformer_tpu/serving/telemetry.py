@@ -22,7 +22,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..inference.admission import AdmissionController
-from ..inference.stats import agg_stats, window_stats
+from ..inference.stats import agg_stats
 from ..inference.telemetry import ServeTelemetryBase
 from ..observability import MetricLogger, RetraceWatchdog
 from .router import Router
@@ -150,9 +150,10 @@ class RouterTelemetry(ServeTelemetryBase):
             post_warmup_compiles=self.post_warmup_compiles,
             **self._router_sections(),
         )
-        latencies = self._drain_latencies()
-        if latencies:
-            fields['request_latency_ms'] = window_stats(latencies)
+        # latency fields (window stats + mergeable histograms) come
+        # from the SAME base helper the single-engine emitter uses —
+        # the two serve-record shapes cannot drift
+        fields.update(self._latency_sections())
         return self._emit('serve', fields)
 
     def close(self) -> dict:

@@ -1,0 +1,337 @@
+"""PR 16 observability tier: request tracing (span trees, the
+completeness invariant, exclusive durations) + SLO aggregation
+(mergeable fixed-boundary histograms — merged-fleet percentiles must
+EXACTLY equal pooled-sample percentiles at bucket resolution), the
+schema'd `trace`/`slo` record kinds, the host-prefixed request-id
+collision fix, and the traced 2-host fleet end to end."""
+import time
+
+import numpy as np
+import pytest
+
+from se3_transformer_tpu.inference import AdmissionController
+from se3_transformer_tpu.observability import PhaseTimer
+from se3_transformer_tpu.observability.schema import (
+    SchemaError, validate_record,
+)
+from se3_transformer_tpu.observability.slo import (
+    DEFAULT_BOUNDS, LatencyHistogram, SLOAggregator,
+    histogram_percentiles, merge_histograms,
+)
+from se3_transformer_tpu.observability.tracing import (
+    Tracer, complete_request_trees, multi_host_traces, orphan_spans,
+    trace_record_body,
+)
+from se3_transformer_tpu.serving import (
+    FleetRouter, HostServer, ReplicaWorker, Router,
+)
+from se3_transformer_tpu.serving.telemetry import RouterTelemetry
+
+from test_fleet import _FakeEngine, _KillableTransport
+
+
+# --------------------------------------------------------------------- #
+# histograms: merged == pooled, exactly
+# --------------------------------------------------------------------- #
+def test_merged_percentiles_exactly_equal_pooled():
+    """THE merge claim: percentiles read off the count-added merged
+    histogram are identical to percentiles of one histogram fed every
+    sample — not approximately, bit-for-bit at bucket resolution."""
+    rng = np.random.RandomState(0)
+    host_a = rng.lognormal(mean=2.0, sigma=1.0, size=400)   # ~7 ms
+    host_b = rng.lognormal(mean=3.5, sigma=0.7, size=150)   # ~33 ms
+    ha, hb, pooled = (LatencyHistogram(), LatencyHistogram(),
+                      LatencyHistogram())
+    for ms in host_a:
+        ha.observe(ms)
+        pooled.observe(ms)
+    for ms in host_b:
+        hb.observe(ms)
+        pooled.observe(ms)
+    merged = merge_histograms([ha.snapshot(), hb.snapshot()])
+    got = histogram_percentiles(merged, qs=(50, 90, 95, 99))
+    want = histogram_percentiles(pooled.snapshot(), qs=(50, 90, 95, 99))
+    assert got == want
+    assert got['count'] == 550
+    # and the bucket-resolution answer brackets the true sample p50
+    true_p50 = float(np.percentile(np.concatenate([host_a, host_b]), 50))
+    i = next(i for i, b in enumerate(DEFAULT_BOUNDS)
+             if b >= got['p50_ms'])
+    lo = DEFAULT_BOUNDS[i - 1] if i else 0.0
+    assert lo < true_p50 <= got['p50_ms'] * (2 ** 0.25)
+
+
+def test_empty_host_merges_as_zero():
+    h = LatencyHistogram()
+    for ms in (1.0, 5.0, 20.0):
+        h.observe(ms)
+    alone = histogram_percentiles(h.snapshot())
+    merged = merge_histograms([h.snapshot(),
+                               LatencyHistogram().snapshot(), None])
+    assert histogram_percentiles(merged) == alone
+    # no hosts at all -> a valid zeroed snapshot, None percentiles
+    empty = merge_histograms([])
+    assert empty['count'] == 0
+    assert len(empty['counts']) == len(empty['bounds']) + 1
+    assert histogram_percentiles(empty)['p99_ms'] is None
+
+
+def test_mismatched_boundaries_refuse_to_merge():
+    custom = LatencyHistogram(bounds=(1.0, 2.0, 4.0)).snapshot()
+    custom['counts'][0] = 1
+    custom['count'] = 1
+    with pytest.raises(ValueError):
+        merge_histograms([LatencyHistogram().snapshot(), custom])
+
+
+# --------------------------------------------------------------------- #
+# tracer unit behavior
+# --------------------------------------------------------------------- #
+def test_tracer_ids_unique_and_end_idempotent():
+    t = [0.0]
+    tr = Tracer(origin='t', clock=lambda: t[0])
+    tids = {tr.mint() for _ in range(100)}
+    assert len(tids) == 100
+    assert all(tid.startswith('req-') for tid in tids)
+    assert tr.mint('ctl').startswith('ctl-')
+    span = tr.begin(next(iter(tids)), 'request')
+    t[0] = 0.010
+    tr.end(span, status='ok')
+    t[0] = 99.0
+    tr.end(span, status='late-loser')        # first terminal site wins
+    assert span['dur_ms'] == 10.0
+    assert span['status'] == 'ok'
+    assert len(tr.spans) == 1
+
+
+def test_completeness_and_orphans():
+    tr = Tracer(origin='t')
+    # a complete request tree: one root, one attached child
+    tid = tr.mint()
+    root = tr.begin(tid, 'request')
+    tr.add(tid, 'attempt', parent_id=root['span'])
+    tr.end(root)
+    # a broken tree: the child references a parent that never recorded
+    bad = tr.mint()
+    bad_root = tr.begin(bad, 'request')
+    tr.add(bad, 'attempt', parent_id='s-vanished-0')
+    tr.end(bad_root)
+    # control traces never count against request completeness
+    ctl = tr.mint('ctl')
+    tr.end(tr.begin(ctl, 'probe'))
+    spans = tr.spans
+    assert complete_request_trees(spans) == [tid]
+    assert [s['trace'] for s in orphan_spans(spans)] == [bad]
+    body = trace_record_body(tr, expected=2)
+    assert body['traces'] == 2          # ctl trace excluded
+    assert body['complete_trees'] == 1
+    assert body['orphan_spans'] == 1
+    assert body['completeness_total'] == 0.5
+    # instrumentation loss: 3 requests resolved but only 2 traced
+    assert trace_record_body(tr, expected=4)['completeness_total'] == 0.25
+
+
+def test_exclusive_durations_nest_within_one_clock_domain():
+    t = [0.0]
+    tr = Tracer(origin='t', clock=lambda: t[0])
+    tid = tr.mint()
+    parent = tr.begin(tid, 'dispatch')
+    tr.add(tid, 'device_run', parent_id=parent['span'], ts=0.002,
+           dur_ms=4.0)
+    t[0] = 0.010
+    tr.end(parent)
+    by_name = trace_record_body(tr)['spans_by_name']
+    assert by_name['dispatch']['total_ms'] == 10.0
+    assert by_name['dispatch']['exclusive_ms'] == 6.0
+    assert by_name['device_run']['exclusive_ms'] == 4.0
+    # a span recorded by a DIFFERENT tracer (another clock domain)
+    # must NOT subtract even when its interval overlaps
+    other = Tracer(origin='elsewhere', clock=lambda: 0.001)
+    foreign = other.begin(tid, 'attempt', parent_id=parent['span'])
+    foreign['dur_ms'] = 8.0
+    tr.extend([foreign])
+    by_name = trace_record_body(tr)['spans_by_name']
+    assert by_name['dispatch']['exclusive_ms'] == 6.0
+
+
+def test_multi_host_counting():
+    tr = Tracer(origin='t', host=None)
+    tid = tr.mint()
+    root = tr.begin(tid, 'request')
+    tr.add(tid, 'attempt', parent_id=root['span'], host=0)
+    tr.add(tid, 'attempt', parent_id=root['span'], host=1)
+    tr.end(root)
+    single = tr.mint()
+    r2 = tr.begin(single, 'request')
+    tr.add(single, 'attempt', parent_id=r2['span'], host=0)
+    tr.end(r2)
+    assert multi_host_traces(tr.spans) == 1
+
+
+# --------------------------------------------------------------------- #
+# schema: both new kinds, positive + negative
+# --------------------------------------------------------------------- #
+def _trace_body():
+    tr = Tracer(origin='t')
+    tid = tr.mint()
+    tr.end(tr.begin(tid, 'request'))
+    return trace_record_body(tr, label='t', expected=1)
+
+
+def test_trace_record_schema():
+    body = _trace_body()
+    validate_record(dict(body, kind='trace', run_id='t'))
+    with pytest.raises(SchemaError):        # missing required field
+        validate_record({k: v for k, v in
+                         dict(body, kind='trace', run_id='t').items()
+                         if k != 'orphan_spans'})
+    with pytest.raises(SchemaError):        # orphans contradict 1.0
+        validate_record(dict(body, kind='trace', run_id='t',
+                             orphan_spans=3))
+    with pytest.raises(SchemaError):        # completeness out of range
+        validate_record(dict(body, kind='trace', run_id='t',
+                             completeness_total=1.5))
+    with pytest.raises(SchemaError):        # complete > traces
+        validate_record(dict(body, kind='trace', run_id='t',
+                             complete_trees=99))
+
+
+def test_slo_record_schema():
+    slo = SLOAggregator()
+    h = LatencyHistogram()
+    h.observe(5.0)
+    slo.fold('0', dict(answered=3, request_failures=0, timeouts=0,
+                       latency_hist={'8': h.snapshot()}))
+    body = slo.record_body(label='t')
+    validate_record(dict(body, kind='slo', run_id='t'))
+    assert body['buckets']['8']['count'] == 1
+    with pytest.raises(SchemaError):        # availability out of range
+        validate_record(dict(body, kind='slo', run_id='t',
+                             availability=1.5))
+    with pytest.raises(SchemaError):        # missing required field
+        validate_record({k: v for k, v in
+                         dict(body, kind='slo', run_id='t').items()
+                         if k != 'error_budget'})
+    with pytest.raises(SchemaError):        # bucket without p99
+        bad = dict(body, kind='slo', run_id='t',
+                   buckets={'8': dict(count=1, p50_ms=1.0, p95_ms=1.0)})
+        validate_record(bad)
+
+
+# --------------------------------------------------------------------- #
+# request-id collision fix: host-prefixed ids
+# --------------------------------------------------------------------- #
+def test_request_ids_disjoint_across_two_hosts():
+    """Two hosts' routers both started at request id 0 — identical ids
+    in fleet-level accounting (dedup, tracing) silently collided. The
+    HostServer now prefixes its router's ids with the host component."""
+    servers = []
+    try:
+        ids = {}
+        for hid in (0, 1):
+            engine = _FakeEngine((4, 8), 2)
+            router = Router(
+                [ReplicaWorker(0, engine, max_wait_ms=5.0)],
+                admission=AdmissionController(max_len=8), max_retries=1)
+            server = HostServer(router, host_id=hid)
+            servers.append(server)
+            rng = np.random.RandomState(hid)
+            pend = [router.submit(rng.randint(0, 8, size=4),
+                                  rng.normal(size=(4, 3))
+                                  .astype(np.float32))
+                    for _ in range(5)]
+            ids[hid] = {p.request_id for p in pend}
+        assert all(isinstance(i, str) for i in ids[0] | ids[1])
+        assert not ids[0] & ids[1], \
+            f'request ids collide across hosts: {ids[0] & ids[1]}'
+        assert all(i.startswith('h0-') for i in ids[0])
+        assert all(i.startswith('h1-') for i in ids[1])
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# --------------------------------------------------------------------- #
+# the traced 2-host fleet, end to end
+# --------------------------------------------------------------------- #
+def test_traced_fleet_end_to_end():
+    """LocalTransport 2-host fleet with a mid-stream host death: every
+    resolved request yields one complete single-root tree (zero
+    orphans, even though the dead host's spans are lost), redispatch
+    hops reconcile with the fleet counter, the redispatched requests
+    show multi-host traces, and the router `serve` record keeps its
+    pre-PR-16 required fields while growing `latency_hist`."""
+    hosts, transports, teles = {}, {}, {}
+    for hid in (0, 1):
+        engine = _FakeEngine((4, 8), 2)
+        worker = ReplicaWorker(0, engine, max_wait_ms=5.0)
+        router = Router([worker],
+                        admission=AdmissionController(max_len=8),
+                        max_retries=1)
+        tele = RouterTelemetry(router, router.admission)
+        server = HostServer(router, host_id=hid, telemetry=tele)
+        hosts[hid] = server
+        teles[hid] = tele
+        transports[hid] = _KillableTransport(server)
+
+    tracer = Tracer(origin='fleet')
+    slo = SLOAggregator()
+    fleet = FleetRouter(transports, max_retries=2,
+                        default_timeout_s=10.0,
+                        heartbeat_every_s=0.01, tracer=tracer, slo=slo)
+    pending = []
+    rng = np.random.RandomState(0)
+    try:
+        for i in range(16):
+            n = int(rng.randint(2, 8))
+            pending.append(fleet.submit(
+                rng.randint(0, 8, size=n),
+                rng.normal(size=(n, 3)).astype(np.float32)))
+            fleet.pump()
+            time.sleep(0.003)
+            if i == 6:
+                transports[0].dead = True       # SIGKILL stand-in
+            if i == 11:
+                transports[0].dead = False
+        deadline = time.monotonic() + 20
+        while (any(not p.done for p in pending)
+               and time.monotonic() < deadline):
+            fleet.drain()
+            fleet.pump()
+            time.sleep(0.005)
+        assert all(p.done for p in pending)
+        assert fleet.scrape() == 2
+        xretries = fleet.cross_host_retries
+        answered = fleet.answered
+        failures = fleet.request_failures
+    finally:
+        fleet.close()
+        for s in hosts.values():
+            s.stop()
+
+    assert answered > 0 and xretries >= 1
+    body = trace_record_body(tracer, label='e2e',
+                             expected=answered + failures)
+    assert body['orphan_spans'] == 0
+    assert body['completeness_total'] == 1.0
+    assert body['redispatch_hops'] == xretries
+    assert body['multi_host_traces'] >= 1
+    for name in ('request', 'attempt', 'admit', 'queue_wait',
+                 'dispatch', 'device_run'):
+        assert name in body['spans_by_name'], name
+    validate_record(dict(body, kind='trace', run_id='t'))
+
+    slo_body = slo.record_body(fleet, label='e2e')
+    validate_record(dict(slo_body, kind='slo', run_id='t'))
+    assert slo_body['hosts'] == 2
+    assert slo_body['answered'] == answered
+    assert any(v['count'] for v in slo_body['buckets'].values())
+
+    # serve-record bit-compat: the PR 2/8 required fields survive and
+    # the mergeable histograms ride along
+    rec = teles[0].flush()
+    for field in ('requests', 'buckets', 'queue_depth', 'runtime',
+                  'post_warmup_compiles', 'replicas', 'health'):
+        assert field in rec, field
+    assert 'latency_hist' in rec
+    validate_record(dict(rec, kind='serve', run_id='t'))

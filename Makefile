@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke serve-fleet-smoke slo-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke flash-smoke chaos-smoke train-chaos-smoke quant-smoke perf-gate clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke serve-fleet-smoke slo-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke v2-smoke flash-smoke chaos-smoke train-chaos-smoke quant-smoke perf-gate clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -65,6 +65,12 @@ so2-smoke:         ## CPU so2-backend gate (docs/PERFORMANCE.md "Higher degrees 
 	python scripts/so2_smoke.py --metrics /tmp/so2_smoke.jsonl
 	python scripts/obs_report.py /tmp/so2_smoke.jsonl --validate --require so2_sweep --out /tmp/so2_smoke_summary.json
 	python scripts/perf_gate.py /tmp/so2_smoke.jsonl
+
+v2-smoke:          ## CPU v2 model-family gate (docs/PERFORMANCE.md "When to pick v1-dense / v1-so2 / v2"): SE3TransformerV2 equivariance at the swept degrees + the v2-vs-(v1+so2) family A/B, schema'd v2_sweep record, judged by the committed v2 perf budgets
+	rm -f /tmp/v2_smoke.jsonl
+	python scripts/v2_smoke.py --metrics /tmp/v2_smoke.jsonl
+	python scripts/obs_report.py /tmp/v2_smoke.jsonl --validate --require v2_sweep --out /tmp/v2_smoke_summary.json
+	python scripts/perf_gate.py /tmp/v2_smoke.jsonl
 
 flash-smoke:       ## CPU streaming-attention gate (docs/PERFORMANCE.md "Flash equivariant attention"): dense-arm + so2-arm parity vs the unfused path (masked rows, XLA stream AND interpret-mode Pallas kernel), fused equivariance at degrees 2/4, schema'd flash A/B record, judged by the committed step-time + peak-HBM win budgets
 	rm -f /tmp/flash_smoke.jsonl

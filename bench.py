@@ -1303,6 +1303,123 @@ def degrees_main(degrees, dense_max: int = 4, steps: int = 5):
     return record
 
 
+def v2_degrees_main(degrees, so2_max: int = 6, steps: int = 5):
+    """`python bench.py --v2-degrees 2,4,6,8`: per-degree A/B of the v2
+    eSCN-direct model family against the v1+so2 baseline on the CPU toy
+    bench (the SE3TransformerV2 acceptance harness).
+
+    Unlike --degrees this is a MODEL-FAMILY A/B, not a backend A/B on
+    identical parameters — v2 is deliberately not checkpoint-compatible
+    with v1 (its radial trunks emit per-m banded blocks directly, no
+    dense-shaped radial output exists to share), so each arm inits its
+    own params and the comparison is per-step wall clock + peak HBM off
+    the cost ledger + the v2 arm's equivariance L2. The v1+so2 arm runs
+    only at degrees <= `so2_max` (default 6): its per-degree canonical-
+    block compile grows steeply on CPU, and past the crossover the v2
+    arm is the only one worth timing — exactly the regime the family
+    exists for.
+
+    Prints ONE bench-shaped JSON line whose value is the v2 arm's
+    nodes*steps/s at the highest swept degree; the per-degree payload
+    (`degrees`: v2 step ms / throughput / equivariance / peak HBM,
+    so2 step ms and so2_vs_v2 where the baseline ran) is what
+    scripts/v2_smoke.py wraps into the schema'd `v2_sweep` record and
+    what the committed budgets judge (PERF_BUDGETS.json:
+    v2_degree6_beats_so2 / v2_degree6_throughput_floor /
+    v2_equivariance_gate_degree_max). Never compared against the
+    RECORD anchors: different program."""
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+    import numpy as np
+
+    from se3_transformer_tpu.models.se3_transformer import (
+        SE3TransformerModule,
+    )
+    from se3_transformer_tpu.observability.costs import cost_payload
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+    from se3_transformer_tpu.utils.validation import equivariance_l2
+    from se3_transformer_tpu.v2 import SE3TransformerV2Module
+
+    enable_compilation_cache()
+    n, k, dim = 128, 12, 8
+    rng = np.random.RandomState(0)
+    feats = jnp.asarray(rng.normal(size=(1, n, dim)), jnp.float32)
+    coors = jnp.asarray(np.cumsum(rng.normal(size=(1, n, 3)), axis=1),
+                        jnp.float32)
+    mask = jnp.ones((1, n), bool)
+
+    def bench_arm(mod, label):
+        params = jax.jit(mod.init, static_argnames=('return_type',))(
+            jax.random.PRNGKey(0), feats, coors, mask=mask,
+            return_type=1)['params']
+        fwd = jax.jit(lambda c: mod.apply({'params': params}, feats, c,
+                                          mask=mask, return_type=1))
+        # AOT-compile so the SAME executable serves the cost ledger and
+        # the timed windows (the --degrees discipline): each arm's
+        # peak-HBM claim is a ledger entry, not prose
+        compiled = fwd.lower(coors).compile()
+        cost = cost_payload(compiled, label=label)
+        out = compiled(coors)
+        out.block_until_ready()                       # warmup
+        best = None
+        for _ in range(2):
+            t0 = time.monotonic()
+            for _ in range(steps):
+                out = compiled(coors)
+            out.block_until_ready()
+            dt = (time.monotonic() - t0) / steps
+            best = dt if best is None or dt < best else best
+        return best, cost, params
+
+    per_degree = {}
+    for d in degrees:
+        v2_mod = SE3TransformerV2Module(
+            dim=dim, depth=2, num_degrees=d + 1, output_degrees=2,
+            reduce_dim_out=True, num_neighbors=k)
+        v2_s, v2_cost, v2_params = bench_arm(v2_mod, f'v2_sweep_d{d}_v2')
+        entry = dict(
+            v2_step_ms=round(v2_s * 1e3, 2),
+            v2_nodes_steps_per_sec=round(n / v2_s, 2),
+            equivariance_l2_v2=equivariance_l2(v2_mod, v2_params, feats,
+                                               coors, mask),
+            v2_peak_hbm_bytes=v2_cost['peak_bytes'],
+            cost={'v2': v2_cost})
+        if d <= so2_max:
+            so2_mod = SE3TransformerModule(
+                dim=dim, depth=1, num_degrees=d + 1, output_degrees=2,
+                reduce_dim_out=True, attend_self=True, num_neighbors=k,
+                heads=2, dim_head=8, num_conv_layers=2,
+                tie_key_values=True, conv_backend='so2',
+                shared_radial_hidden=True)
+            so2_s, so2_cost, _ = bench_arm(so2_mod, f'v2_sweep_d{d}_so2')
+            entry['so2_step_ms'] = round(so2_s * 1e3, 2)
+            entry['so2_vs_v2'] = round(so2_s / v2_s, 3)
+            entry['so2_peak_hbm_bytes'] = so2_cost['peak_bytes']
+            entry['cost']['so2'] = so2_cost
+        per_degree[str(d)] = entry
+        print(f'degree {d}: {entry}', file=sys.stderr)
+
+    top = str(max(degrees))
+    record = {
+        'metric': f'v2_degree_sweep(dim={dim},n={n},k={k},'
+                  f'degrees={",".join(str(d) for d in degrees)},'
+                  f'backend=cpu)',
+        'value': per_degree[top]['v2_nodes_steps_per_sec'],
+        'unit': 'nodes*steps/sec/cpu-host',
+        'vs_baseline': 1.0,     # own-program A/B; anchors don't apply
+        'mode': 'v2_sweep',
+        'timing': 'best-of-2',
+        'degrees': per_degree,
+    }
+    if os.environ.get('SE3_TPU_CODE_REV'):
+        record['code_rev'] = os.environ['SE3_TPU_CODE_REV']
+    print(json.dumps(record))
+    return record
+
+
 if __name__ == '__main__':
     if '--flash' in sys.argv[1:]:
         # CPU A/B harness (no device probe, like --degrees): streaming
@@ -1324,6 +1441,21 @@ if __name__ == '__main__':
         if '--steps' in sys.argv[1:]:
             _steps = int(sys.argv[sys.argv.index('--steps') + 1])
         quant_main(mix=_mix, steps=_steps)
+        sys.exit(0)
+    if '--v2-degrees' in sys.argv[1:]:
+        # CPU A/B harness (no device probe, like --degrees): per-degree
+        # v2-vs-(v1+so2) model-family comparison, flags parsed before
+        # jax initializes its backends
+        _i = sys.argv.index('--v2-degrees')
+        _degs = [int(x) for x in sys.argv[_i + 1].split(',')] \
+            if len(sys.argv) > _i + 1 else [2, 4]
+        _sm = 6
+        if '--so2-max' in sys.argv[1:]:
+            _sm = int(sys.argv[sys.argv.index('--so2-max') + 1])
+        _steps = 5
+        if '--steps' in sys.argv[1:]:
+            _steps = int(sys.argv[sys.argv.index('--steps') + 1])
+        v2_degrees_main(_degs, so2_max=_sm, steps=_steps)
         sys.exit(0)
     if '--degrees' in sys.argv[1:]:
         # CPU A/B harness (no device probe, like --ring): per-degree

@@ -320,6 +320,39 @@ def _gate_so2_sweep(records):
     return True
 
 
+def _gate_v2_sweep(records):
+    sweeps = [r for r in records if r.get('kind') == 'v2_sweep']
+    if not sweeps:
+        print('V2 GATE: no v2_sweep records in the stream (was '
+              'scripts/v2_smoke.py / bench.py --v2-degrees run?)',
+              file=sys.stderr)
+        return False
+    last = sweeps[-1]
+    degrees = last.get('degrees') or {}
+    bad_eq = [d for d, e in degrees.items()
+              if not isinstance(e.get('equivariance_l2_v2'),
+                                (int, float))
+              or e['equivariance_l2_v2'] >= 1e-4]
+    if bad_eq:
+        print(f'V2 GATE: v2 equivariance L2 >= 1e-4 (or missing) at '
+              f'degree(s) {sorted(bad_eq)} — the eSCN-direct family '
+              f'broke equivariance', file=sys.stderr)
+        return False
+    ab = {d: e['so2_vs_v2'] for d, e in degrees.items()
+          if 'so2_vs_v2' in e}
+    if not ab:
+        print('V2 GATE: no degree carries the v1+so2 baseline arm — '
+              'the sweep proves equivariance but no family A/B (the '
+              'perf budgets need so2_vs_v2)', file=sys.stderr)
+        return False
+    print(f'v2 gate ok: degrees {sorted(degrees)}, so2_vs_v2 '
+          f'{ab}, worst eq '
+          f'{max(e["equivariance_l2_v2"] for e in degrees.values()):.2e}'
+          f' (the win itself is enforced by scripts/perf_gate.py)',
+          file=sys.stderr)
+    return True
+
+
 def _gate_flash(records):
     recs = [r for r in records if r.get('kind') == 'flash']
     if not recs:
@@ -439,7 +472,8 @@ def _gate_slo(records):
 _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       tune=_gate_tune, cost=_gate_cost,
                       profile=_gate_profile, serve=_gate_serve,
-                      so2_sweep=_gate_so2_sweep, flash=_gate_flash,
+                      so2_sweep=_gate_so2_sweep,
+                      v2_sweep=_gate_v2_sweep, flash=_gate_flash,
                       fault=_gate_fault, guard=_gate_guard,
                       fleet=_gate_fleet, quant_ab=_gate_quant_ab,
                       trace=_gate_trace, slo=_gate_slo)

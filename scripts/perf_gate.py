@@ -86,7 +86,8 @@ DEFAULT_RECORDS = ('BENCH_r05.json', 'WIDTH_TABLE.jsonl',
                    'SERVE_MULTI.jsonl', 'SO2_SWEEP.jsonl',
                    'FLASH_AB.jsonl', 'CHAOS_SMOKE.jsonl',
                    'QUANT_AB.jsonl', 'TRAIN_CHAOS.jsonl',
-                   'FLEET_CHAOS.jsonl', 'SLO_SMOKE.jsonl')
+                   'FLEET_CHAOS.jsonl', 'SLO_SMOKE.jsonl',
+                   'V2_SWEEP.jsonl')
 
 
 # --------------------------------------------------------------------- #

@@ -1,0 +1,100 @@
+"""CPU gate for the SE3TransformerV2 eSCN-direct family (`make v2-smoke`).
+
+Three gates, exit non-zero on any failure:
+
+  1. EQUIVARIANCE — the v2 arm's equivariance L2 must stay under 1e-4
+     at every swept degree (~1e-6 in practice: the per-m banded blocks
+     commute exactly with the frame rotations, and the separable S2
+     activation's per-degree grids are sized to quadrature accuracy);
+  2. SANITY — wherever the v1+so2 baseline arm ran, its step time and
+     the so2_vs_v2 ratio must be present and non-degenerate (the
+     family A/B the committed degree-6 win budget judges);
+  3. SCHEMA + RECORD — the per-degree A/B payload from
+     bench.v2_degrees_main is written as a schema'd `v2_sweep` record
+     (run_meta header, observability.schema validation). The Makefile
+     target then runs `obs_report --require v2_sweep` and
+     `perf_gate.py` on the stream, so the committed degree-6
+     throughput floor judges the fresh numbers.
+
+    python scripts/v2_smoke.py [--metrics V2.jsonl]
+        [--degrees 2,4,6] [--so2-max 4] [--steps 5]
+
+Default degrees are 2,4,6 with the v1+so2 baseline capped at degree 4
+(the smoke's CPU budget — the so2 arm's degree-6 compile is the slow
+part, and the degree-6 v2 throughput floor needs only the v2 arm). The
+committed V2_SWEEP.jsonl evidence was produced with --degrees 2,4,6,8
+--so2-max 6, which is what the degree-6 win and degree-8 equivariance
+budgets judge.
+"""
+import argparse
+import json
+import os
+import sys
+import uuid
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+EQ_TOL = 1e-4
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='v2 model-family equivariance + degree-sweep '
+                    'record gate')
+    ap.add_argument('--metrics', default=None,
+                    help='write the schema-valid v2_sweep stream here')
+    ap.add_argument('--degrees', default='2,4,6')
+    ap.add_argument('--so2-max', type=int, default=4)
+    ap.add_argument('--steps', type=int, default=5)
+    args = ap.parse_args(argv)
+    degrees = [int(x) for x in args.degrees.split(',')]
+
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+
+    import bench
+
+    record = bench.v2_degrees_main(degrees, so2_max=args.so2_max,
+                                   steps=args.steps)
+
+    ok = True
+    for d, entry in sorted(record['degrees'].items(), key=lambda kv:
+                           int(kv[0])):
+        eq = entry.get('equivariance_l2_v2')
+        if eq is None or eq >= EQ_TOL:
+            print(f'FAIL: v2 equivariance L2 {eq} >= {EQ_TOL} at '
+                  f'degree {d}')
+            ok = False
+        if 'so2_step_ms' in entry:
+            if entry.get('so2_vs_v2', 0) <= 0:
+                print(f'FAIL: degenerate so2_vs_v2 at degree {d}: '
+                      f'{entry.get("so2_vs_v2")!r}')
+                ok = False
+
+    if args.metrics:
+        from se3_transformer_tpu.observability.report import (
+            write_record_stream,
+        )
+        from se3_transformer_tpu.observability.schema import (
+            validate_stream,
+        )
+        body = dict(kind='v2_sweep', label=record['metric'],
+                    degrees=record['degrees'],
+                    value=record['value'], unit=record['unit'],
+                    timing=record['timing'])
+        write_record_stream(args.metrics,
+                            f'v2_smoke_{uuid.uuid4().hex[:8]}', [body])
+        info = validate_stream(args.metrics)
+        print(f'schema ok: {info["records"]} records {info["kinds"]}')
+
+    summary = dict(ok=ok, degrees=record['degrees'])
+    print(json.dumps(summary))
+    if not ok:
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

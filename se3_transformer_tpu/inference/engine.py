@@ -94,6 +94,10 @@ class InferenceEngine:
                  precompile: bool = True,
                  fault_injector=None):
         self.module = module
+        # the family capability signal (replica snapshots / HostServer
+        # stats surface it for family-aware fleet placement; v1 modules
+        # stamp 'se3_v1', v2 'se3_v2')
+        self.model_family = getattr(module, 'model_family', 'se3_v1')
         self.mesh = mesh
         # chaos-harness hook (faults.FaultInjector): fires at the top
         # of run() so injected engine failures/latency walk the real
@@ -151,9 +155,16 @@ class InferenceEngine:
                         step: Optional[int] = None, **kwargs
                         ) -> 'InferenceEngine':
         """Params-only restore (`CheckpointManager.restore_params`) —
-        optimizer state never materializes on the serving host."""
+        optimizer state never materializes on the serving host. The
+        module's `model_family` stamp rides into the manager, so
+        loading a v1 checkpoint into a v2 module (or vice versa) fails
+        with the structured ModelFamilyMismatch, not a flax key
+        error."""
         from ..training.checkpoint import CheckpointManager
-        params = CheckpointManager(checkpoint_dir).restore_params(step)
+        params = CheckpointManager(
+            checkpoint_dir,
+            model_family=getattr(module, 'model_family', None),
+        ).restore_params(step)
         return cls(module, params, **kwargs)
 
     # ------------------------------------------------------------------ #
@@ -414,6 +425,7 @@ class InferenceEngine:
             buckets=list(self.buckets), batch_size=self.batch_size,
             dtype=self.dtype_name, sharding=sharding,
             precision=self.precision_name,
+            model_family=self.model_family,
             quant=(dict(self.quant_report)
                    if self.quant_report is not None else None),
             executables=[list(k) for k in self._executables],

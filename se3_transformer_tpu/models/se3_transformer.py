@@ -190,6 +190,13 @@ class SE3TransformerModule(nn.Module):
     ring_overlap: bool = True
     ring_exchange: bool = True
 
+    # checkpoint/capability family stamp (no annotation: NOT a flax
+    # field). training/checkpoint.py guards restores on it — a v1
+    # checkpoint must never be silently keyed into the v2 family
+    # (se3_transformer_tpu/v2) or vice versa — and serving surfaces it
+    # next to the precision mixes for family-aware placement.
+    model_family = 'se3_v1'
+
     def __post_init__(self):
         # fiber dicts arrive as {degree: channels} with INT keys — the
         # reference's constructor surface. flax registers submodule
@@ -833,6 +840,8 @@ class SE3Transformer:
     se3_transformer_tpu.training) — this wrapper is for parity tests and
     interactive exploration.
     """
+
+    model_family = 'se3_v1'
 
     def __init__(self, *, seed: int = 0, **kwargs):
         self.module = SE3TransformerModule(**kwargs)

@@ -151,6 +151,16 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    ran, dense_step_ms + dense_vs_so2 + parity_l2}).
                    `make so2-smoke` gates on it and PERF_BUDGETS.json
                    enforces the degree-4 win + throughput floor.
+  v2_sweep         per-degree v2-vs-(v1+so2) model-family A/B
+                   (bench.v2_degrees_main via scripts/v2_smoke.py):
+                   label, degrees (per-max-degree {v2_step_ms,
+                   v2_nodes_steps_per_sec, equivariance_l2_v2 — the
+                   load-bearing gate field — v2_peak_hbm_bytes off the
+                   cost ledger, and, where the v1+so2 arm ran,
+                   so2_step_ms + so2_vs_v2 — the family A/B ratio}).
+                   `make v2-smoke` gates on it and PERF_BUDGETS.json
+                   enforces the degree-6 win + throughput floor +
+                   equivariance ceiling.
   trace            fleet-wide request-tracing evidence for one run
                    (observability.tracing.trace_record_body, exercised
                    by scripts/slo_smoke.py and the chaos smokes):
@@ -199,8 +209,8 @@ SCHEMA_VERSION = 1
 
 KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
                'serve', 'tune', 'comm', 'cost', 'profile', 'so2_sweep',
-               'flash', 'fault', 'guard', 'fleet', 'quant_ab', 'trace',
-               'slo', 'summary')
+               'v2_sweep', 'flash', 'fault', 'guard', 'fleet', 'quant_ab',
+               'trace', 'slo', 'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
@@ -284,6 +294,10 @@ _REQUIRED = {
     # backend contract: a sweep record that cannot say the reduced
     # contraction is still equivariant proves nothing about the speedup
     'so2_sweep': ('run_id', 'label', 'degrees'),
+    # same contract for the model-family A/B: equivariance_l2_v2 per
+    # degree is load-bearing — a family sweep that cannot say the
+    # per-m parameterization is still equivariant proves nothing
+    'v2_sweep': ('run_id', 'label', 'degrees'),
     # the ratio pair + the equivariance figure are the load-bearing
     # trio of the streaming-attention contract: a flash record that
     # cannot say whether the fused arm was faster, smaller, AND still
@@ -355,6 +369,15 @@ def _validate_latency_hist(hist, index, where):
                          f'{total} contradicts counts summing to '
                          f'{sum(counts)} — the snapshot cannot merge '
                          f'exactly')
+
+
+def _validate_model_families(val, index, where):
+    """A family capability list (serve records / fleet host stats):
+    non-empty list of non-empty strings (e.g. ['se3_v1', 'se3_v2'])."""
+    if not isinstance(val, list) or not val or any(
+            not isinstance(f, str) or not f for f in val):
+        _fail(index, f'{where} must be a non-empty list of non-empty '
+                     f'strings (model families served), got {val!r}')
 
 
 def validate_record(rec: dict, index=None) -> dict:
@@ -431,6 +454,18 @@ def validate_record(rec: dict, index=None) -> dict:
                     _fail(index, f'replicas[{rid!r}] must carry depth '
                                  f'(per-replica depth IS the load '
                                  f'surface)')
+                if 'model_family' in snap and (
+                        not isinstance(snap['model_family'], str)
+                        or not snap['model_family']):
+                    _fail(index, f'replicas[{rid!r}].model_family must '
+                                 f'be a non-empty string, got '
+                                 f'{snap["model_family"]!r}')
+        # the family capability signal (heterogeneous serving: v1/v2
+        # replicas behind one router) — optional but validated when
+        # present, because fleet placement will route on it
+        if 'model_families' in rec:
+            _validate_model_families(rec['model_families'], index,
+                                     'serve.model_families')
         if 'swaps' in rec:
             swaps = rec['swaps']
             if not isinstance(swaps, dict) \
@@ -496,6 +531,11 @@ def validate_record(rec: dict, index=None) -> dict:
                     or snap.get('state') not in _HEALTH_STATES:
                 _fail(index, f'fleet.hosts[{hid!r}] must carry a state '
                              f'in {_HEALTH_STATES}')
+            stats = snap.get('stats')
+            if isinstance(stats, dict) and 'model_families' in stats:
+                _validate_model_families(
+                    stats['model_families'], index,
+                    f'fleet.hosts[{hid!r}].stats.model_families')
         if not isinstance(rec['host_transitions'], list):
             _fail(index, 'fleet.host_transitions must be a list (the '
                          'host-breaker evidence log, empty when clean)')
@@ -747,6 +787,27 @@ def validate_record(rec: dict, index=None) -> dict:
                 _fail(index, f'degrees[{deg!r}] carries dense_step_ms '
                              f'but no numeric dense_vs_so2 — the A/B '
                              f'ratio IS the record')
+    if kind == 'v2_sweep':
+        degrees = rec['degrees']
+        if not isinstance(degrees, dict) or not degrees:
+            _fail(index, 'v2_sweep.degrees must be a non-empty object '
+                         '(max degree -> A/B entry)')
+        for deg, entry in degrees.items():
+            if not isinstance(entry, dict):
+                _fail(index, f'degrees[{deg!r}] must be an object')
+            for field in ('v2_step_ms', 'v2_nodes_steps_per_sec',
+                          'equivariance_l2_v2'):
+                val = entry.get(field)
+                if not isinstance(val, (int, float)) or val < 0 \
+                        or isinstance(val, bool):
+                    _fail(index, f'degrees[{deg!r}].{field} must be a '
+                                 f'non-negative number, got {val!r}')
+            if 'so2_step_ms' in entry and \
+                    not isinstance(entry.get('so2_vs_v2'),
+                                   (int, float)):
+                _fail(index, f'degrees[{deg!r}] carries so2_step_ms '
+                             f'but no numeric so2_vs_v2 — the family '
+                             f'A/B ratio IS the record')
     if kind in ('flush', 'summary'):
         timing = rec['timing']
         if not isinstance(timing, dict):

@@ -102,6 +102,11 @@ def tp_rules(axis: str = 'tp') -> Rules:
         # the same rank and a divisible output axis
         (r'(^|/)w3(_\d+_\d+)?(/(?:q|scale))?$', P(None, None, axis), 3),
         (r'(^|/)b3(_\d+_\d+)?$', P(None, axis), 2),
+        # v2 per-m radial blocks 'wm{m}_{d_in}_{d_out}' [mid, K, O] and
+        # their biases (v2/conv.py): same layout family as w3/b3 — the
+        # output-channel axis shards, quantized q/scale descend alike
+        (r'(^|/)wm\d+_\d+_\d+(/(?:q|scale))?$', P(None, None, axis), 3),
+        (r'(^|/)bm\d+_\d+_\d+$', P(None, axis), 2),
         # attention/FF in-projections: column-shard the output axis
         # (= heads * dim_head, i.e. head sharding); scale [1, out]
         # shards its output axis right along
@@ -130,7 +135,9 @@ def fsdp_rules(axis: str = 'dp') -> Rules:
     to the quantizable weight names (w<d> / w3_i_o / Dense kernel) so
     flax's LayerNorm `scale` param keeps its plain dim-0 treatment."""
     return (
-        (r'(^|/)(?:w\d+(?:_\d+_\d+)?|kernel)/scale$', P()),
+        # wm\d+_\d+_\d+ covers the v2 per-m radial blocks (v2/conv.py)
+        (r'(^|/)(?:w\d+(?:_\d+_\d+)?|wm\d+_\d+_\d+|kernel)/scale$',
+         P()),
         (r'.*', P(axis)),
     )
 

@@ -18,7 +18,9 @@ restricted to the INVARIANT-INPUT matmuls — degree-0 LinearSE3 channel
 mixers (`w0`: FF project_in/out, attention to_q/to_out/to_self_*,
 self_interact), the radial matmul weights (`w3` / grouped
 `w3_{din}_{dout}` — where the bytes are, shared by the dense AND so2
-backends), and the radial trunk's Dense kernels. Their inputs are
+backends — and v2's per-m `wm{m}_{din}_{dout}` blocks, which are the
+same invariant-input radial matmul in eSCN-direct form), and the
+radial trunk's Dense kernels. Their inputs are
 rotation-invariant scalars, so weight quantization error cancels in
 the equivariance measurement. Higher-degree (l>0) channel mixers get a
 bf16 PASSTHROUGH at most: rotation error compounds on exactly those
@@ -55,11 +57,16 @@ MixSpec = Union[str, PrecisionRules]
 # each with the rank that identifies it:
 #   w0 [in, out]           degree-0 LinearSE3 channel mixers
 #   w3 / w3_i_o [m, IF, O] radial matmul weights (dense + so2 + flash)
+#   wm{m}_i_o [mid, K, O]  v2 per-m banded radial blocks (eSCN-direct)
 #   Dense_0/1 kernel       the radial trunk's hidden matmuls
+# ('wm3' contains no digit after the leading w, so the `w\d+` mixer
+# and `w3` radial patterns cannot collide with it — and vice versa)
 _W0_RE = r'(^|/)w0$'
 _W3_RE = r'(^|/)w3(_\d+_\d+)?$'
+_WM_RE = r'(^|/)wm\d+_\d+_\d+$'
 _RADIAL_DENSE_RE = r'(^|/)Dense_[01]/kernel$'
-_INT8_SAFE = ((_W0_RE, 2), (_W3_RE, 3), (_RADIAL_DENSE_RE, 2))
+_INT8_SAFE = ((_W0_RE, 2), (_W3_RE, 3), (_WM_RE, 3),
+              (_RADIAL_DENSE_RE, 2))
 
 # higher-degree LinearSE3 channel mixers: bf16 at most (this also
 # catches a 2-d `w3` MIXER after the rank guard rejects it above)
@@ -75,6 +82,7 @@ def _mix_rules(low: str) -> PrecisionRules:
     return (
         (_W0_RE, low, 2),
         (_W3_RE, low, 3),
+        (_WM_RE, low, 3),
         (_RADIAL_DENSE_RE, low, 2),
         (_WL_RE, 'bf16'),
         (r'.*', 'fp32'),

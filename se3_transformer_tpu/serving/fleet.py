@@ -348,6 +348,9 @@ class HostServer:
             precision_mixes=sorted({
                 getattr(w.engine, 'precision_name', 'fp32')
                 for w in r.workers}),
+            model_families=sorted({
+                getattr(w.engine, 'model_family', 'se3_v1')
+                for w in r.workers}),
             served=sum(w.served_rows for w in r.workers),
             batches=r.batches_dispatched,
             retries=r.retries,
@@ -1049,8 +1052,8 @@ class FleetRouter:
                     k: h.stats.get(k)
                     for k in ('queue_depth', 'served', 'batches',
                               'request_failures', 'retries', 'timeouts',
-                              'precision_mixes', 'swaps',
-                              'post_warmup_compiles')
+                              'precision_mixes', 'model_families',
+                              'swaps', 'post_warmup_compiles')
                     if k in h.stats}
             if h.last_error:
                 entry['last_error'] = h.last_error

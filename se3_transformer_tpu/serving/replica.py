@@ -462,6 +462,8 @@ class ReplicaWorker:
         return dict(depth=self.batcher.depth,
                     precision=getattr(self.engine, 'precision_name',
                                       'fp32'),
+                    model_family=getattr(self.engine, 'model_family',
+                                         'se3_v1'),
                     served=self.served_rows,
                     batches=self.batcher.batches_dispatched,
                     continuous_admissions=self.batcher.continuous_admissions,

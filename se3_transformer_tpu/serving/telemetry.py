@@ -86,6 +86,12 @@ class RouterTelemetry(ServeTelemetryBase):
             precision_mixes=sorted({
                 getattr(w.engine, 'precision_name', 'fp32')
                 for w in router.workers}),
+            # same heterogeneous-serving shape for the model families
+            # (v1/v2 replicas may coexist behind one router; the
+            # per-replica value is in each snapshot)
+            model_families=sorted({
+                getattr(w.engine, 'model_family', 'se3_v1')
+                for w in router.workers}),
             swaps=dict(count=len(router.swap_events),
                        events=list(router.swap_events)),
             continuous_admissions=router.continuous_admissions,

@@ -84,7 +84,11 @@ def edge_frames(rel_pos: jnp.ndarray, max_degree: int,
     # stay valid rotations so roundtrips and gradients never degrade)
     degenerate = norm <= _EPS
     cos_b = jnp.where(degenerate, 1.0, z)
-    sin_b = jnp.where(degenerate, 0.0, jnp.sqrt(rho_sq))
+    # sin(beta) is rho itself — reuse the CLAMPED rho, not
+    # sqrt(rho_sq): the bare sqrt's derivative is infinite at 0, and
+    # where() does not block the NaN cotangent (pole and coincident
+    # edges would poison coordinate gradients)
+    sin_b = jnp.where(degenerate, 0.0, rho)
 
     out = dict(zip(('cos_a', 'sin_a'), _harmonics(cos_a, sin_a,
                                                   max_degree)))

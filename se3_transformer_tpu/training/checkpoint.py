@@ -54,6 +54,29 @@ except Exception:  # pragma: no cover - orbax is in the image, but be safe
 _STEP_ENTRY = re.compile(r'^step_(\d+)(\.pkl)?$')
 
 
+class ModelFamilyMismatch(ValueError):
+    """A checkpoint stamped for one model family was asked to restore
+    into another (v1 <-> v2). Structured and LOUD by design: without
+    the guard this surfaces as an opaque flax shape/key error deep in
+    apply. Never caught by `restore()`'s torn-checkpoint fallback —
+    a family mismatch is a configuration error (wrong checkpoint
+    directory for this model), not a corrupt entry."""
+
+    def __init__(self, expected: str, found: str, step: int,
+                 directory: str):
+        self.expected = expected
+        self.found = found
+        self.step = step
+        self.directory = directory
+        super().__init__(
+            f'checkpoint model-family mismatch: step {step} in '
+            f'{directory} was saved by model family {found!r} but this '
+            f'manager restores for {expected!r} — the families are '
+            f'deliberately not checkpoint-compatible (per-m radial '
+            f'parameterization differs); point the manager at a '
+            f'{expected!r} checkpoint directory')
+
+
 def _copy_leaf(x):
     """A real op (never identity) so jit cannot forward the input buffer
     to the output: the snapshot must survive a later step donating the
@@ -98,9 +121,15 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 fault_injector=None, writer_timeout_s: float = 300.0):
+                 fault_injector=None, writer_timeout_s: float = 300.0,
+                 model_family: Optional[str] = None):
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
+        # the family guard: when set, every save stamps a
+        # step_N.meta.json sidecar and every restore checks it
+        # (ModelFamilyMismatch on disagreement). None = unguarded —
+        # pre-v2 checkpoints carry no stamp and keep restoring.
+        self.model_family = model_family
         os.makedirs(self.directory, exist_ok=True)
         self._ckptr = ocp.StandardCheckpointer() if _HAS_ORBAX else None
         self._async_thread: Optional[threading.Thread] = None
@@ -123,6 +152,40 @@ class CheckpointManager:
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f'step_{step:08d}')
+
+    def _meta_path(self, step: int) -> str:
+        # NOT matched by _STEP_ENTRY: the sidecar can never surface as
+        # a checkpoint entry through all_steps/latest_step
+        return self._step_dir(step) + '.meta.json'
+
+    def _write_meta(self, step: int):
+        if self.model_family is None:
+            return
+        import json
+        tmp = self._meta_path(step) + '.tmp'
+        with open(tmp, 'w') as f:
+            json.dump({'model_family': self.model_family}, f)
+        os.replace(tmp, self._meta_path(step))
+
+    def _stamped_family(self, step: int) -> Optional[str]:
+        try:
+            import json
+            with open(self._meta_path(step)) as f:
+                return json.load(f).get('model_family')
+        except (OSError, ValueError):
+            return None   # unstamped (pre-guard) or unreadable sidecar
+
+    def _check_family(self, step: int):
+        """The restore-side guard: raise ModelFamilyMismatch BEFORE any
+        array data moves when the sidecar stamp disagrees with this
+        manager's family. Unstamped steps (or an unguarded manager)
+        pass — back-compat with pre-guard checkpoints."""
+        if self.model_family is None:
+            return
+        found = self._stamped_family(int(step))
+        if found is not None and found != self.model_family:
+            raise ModelFamilyMismatch(self.model_family, found,
+                                      int(step), self.directory)
 
     def all_steps(self):
         steps = []
@@ -168,6 +231,10 @@ class CheckpointManager:
             with open(tmp, 'wb') as f:
                 pickle.dump(state, f)
             os.replace(tmp, path)
+        # family stamp AFTER the durable entry: a crash between the two
+        # leaves an unstamped-but-valid step (restores under back-
+        # compat), never a stamped-but-missing one
+        self._write_meta(int(step))
         if self.fault_injector is not None:
             self.fault_injector.fire('checkpoint_written', step=int(step),
                                      path=path)
@@ -272,6 +339,12 @@ class CheckpointManager:
         for step in reversed(steps):
             try:
                 state = restore_one(step)
+            except ModelFamilyMismatch:
+                # NOT a torn entry: the caller pointed a v1 manager at
+                # a v2 checkpoint directory (or vice versa). Falling
+                # back would silently serve the wrong-family tree or an
+                # ancient same-family step — fail loud instead.
+                raise
             except Exception as e:  # noqa: BLE001 - corrupt entries vary
                 errors.append((step, f'{type(e).__name__}: {e}'))
                 warnings.warn(
@@ -309,6 +382,7 @@ class CheckpointManager:
             lambda s: self._restore_step(s, like), 'restore')
 
     def _restore_step(self, step: int, like: Any = None) -> Any:
+        self._check_family(step)
         if self._ckptr is not None and os.path.isdir(self._step_dir(step)):
             target = None
             if like is not None:
@@ -360,6 +434,7 @@ class CheckpointManager:
                                       'restore params from')
 
     def _restore_params_step(self, step: int) -> Any:
+        self._check_family(step)
         path = self._step_dir(step)
         if self._ckptr is not None and os.path.isdir(path):
             # tuple-rooted states flatten to string keys '0', '1', ... in
@@ -447,4 +522,6 @@ class CheckpointManager:
                 shutil.rmtree(path, ignore_errors=True)
             elif os.path.exists(path + '.pkl'):
                 os.remove(path + '.pkl')
+            if os.path.exists(self._meta_path(step)):
+                os.remove(self._meta_path(step))
             self._verified.discard(step)

@@ -1,0 +1,112 @@
+"""Separable S2 activation: the v2 replacement for NormSE3.
+
+Two composed parts, both cheap and both degree-local ("separable"):
+
+  1. an EXACTLY equivariant per-degree scalar gate — a Dense head on
+     the invariant l=0 channel, sigmoid, multiplying each l>0 degree's
+     channels (the only learned piece);
+  2. a pointwise nonlinearity on a fixed S2 grid (optional,
+     ``grid_nonlin``): each degree's channel c is synthesized to a
+     function f(omega) = sum_m x_m Y_lm(omega) on a Gauss-Legendre x
+     uniform-phi grid, gelu'd pointwise, and analyzed back onto the
+     SAME degree-l harmonics. Rotation acts on f by composition and
+     commutes with any pointwise map in the continuum, so the ONLY
+     equivariance cost is quadrature aliasing of gelu(f)'s tail
+     spectrum — with the default grid that measures ~1e-7 at degree 8
+     (tests/test_v2.py gates it with the rest of the family at 1e-4).
+
+The synthesis/analysis matrices are host-float64 constants built from
+so3.spherical_harmonics (xp=np) with the analysis solved against the
+grid Gram matrix, so analysis(synthesis(x)) == x to float64 regardless
+of the SH normalization convention — this is what makes padded and
+unpadded forwards agree exactly (zero features stay exactly zero
+through the grid roundtrip: gelu(0) == 0).
+
+NormSE3's norm-nonlinearity needs the safe_norm clip to keep grads
+finite at zero features; the S2 path has no norm at all, so grads are
+finite at degenerate geometry (frames.py pole-guard cases) for free.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Callable, Dict, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.fiber import Fiber
+
+Features = Dict[str, jnp.ndarray]
+
+
+@lru_cache(maxsize=None)
+def s2_grid_matrices(degree: int, n_theta: int, n_phi: int):
+    """(synthesis [G, 2l+1], analysis [2l+1, G]) for one degree on the
+    Gauss-Legendre(cos theta) x uniform(phi) grid, host float64.
+    analysis @ synthesis == I to quadrature exactness (n_theta > l,
+    n_phi > 2l): the Gram solve absorbs the SH normalization."""
+    from ..so3.spherical_harmonics import (angles_to_xyz,
+                                           real_spherical_harmonics)
+    nodes, glw = np.polynomial.legendre.leggauss(n_theta)
+    theta = np.arccos(nodes)                       # [n_theta]
+    phi = 2.0 * np.pi * np.arange(n_phi) / n_phi   # [n_phi]
+    tt, pp = np.meshgrid(theta, phi, indexing='ij')
+    xyz = angles_to_xyz(tt.reshape(-1), pp.reshape(-1), xp=np)
+    Y = np.asarray(real_spherical_harmonics(degree, xyz, xp=np),
+                   dtype=np.float64)               # [G, 2l+1]
+    w = np.repeat(glw, n_phi) * (2.0 * np.pi / n_phi)  # [G]
+    Yw = Y.T * w[None, :]                          # [2l+1, G]
+    gram = Yw @ Y                                  # [2l+1, 2l+1]
+    A = np.linalg.solve(gram, Yw)
+    return Y, A
+
+
+def default_grid(degree: int, resolution: Optional[int] = None):
+    """(n_theta, n_phi) for one degree. 2l+2 theta nodes already make
+    the LINEAR roundtrip exact; the default oversamples ~2x beyond
+    that so gelu's alias tail lands below ~1e-6 (measured: 4(l+1)
+    nodes give ~5e-7 equivariance at l = 6 and 8 — see
+    tests/test_v2.py). Per-degree grids keep low degrees cheap: only
+    the top of the fiber pays for the fine grid."""
+    n_theta = resolution if resolution is not None \
+        else max(4 * (degree + 1), 8)
+    assert n_theta >= degree + 1, \
+        f's2 grid resolution {n_theta} cannot resolve degree {degree}'
+    return n_theta, 2 * n_theta + 1
+
+
+class SeparableS2Activation(nn.Module):
+    """See module docstring. Drop-in for NormSE3 in the v2 blocks:
+    Features -> Features, same fiber in and out."""
+    fiber: Fiber
+    nonlin: Callable = nn.gelu
+    # the S2-grid pointwise nonlinearity on l>0 degrees; False leaves
+    # the gate as the only l>0 transform (exactly equivariant mode)
+    grid_nonlin: bool = True
+    # theta nodes override (None -> default_grid)
+    resolution: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, features: Features) -> Features:
+        x0 = features['0']                         # [..., C0, 1]
+        scalars = x0[..., 0]
+
+        out = {}
+        for degree, channels in self.fiber:
+            key = str(degree)
+            x = features[key]
+            if degree == 0:
+                out[key] = self.nonlin(x)
+                continue
+            if self.grid_nonlin:
+                n_theta, n_phi = default_grid(degree, self.resolution)
+                Y, A = s2_grid_matrices(degree, n_theta, n_phi)
+                synth = jnp.asarray(Y, x.dtype)
+                analy = jnp.asarray(A, x.dtype)
+                f = jnp.einsum('...cp,gp->...cg', x, synth)
+                x = jnp.einsum('...cg,pg->...cp', self.nonlin(f), analy)
+            gate = nn.sigmoid(nn.Dense(channels,
+                                       name=f'gate{degree}')(scalars))
+            out[key] = x * gate[..., None]
+        return out

@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke serve-fleet-smoke slo-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke v2-smoke flash-smoke chaos-smoke train-chaos-smoke quant-smoke perf-gate clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke serve-fleet-smoke slo-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke v2-smoke flash-smoke assembly-smoke chaos-smoke train-chaos-smoke quant-smoke perf-gate clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -77,6 +77,14 @@ flash-smoke:       ## CPU streaming-attention gate (docs/PERFORMANCE.md "Flash e
 	python scripts/flash_smoke.py --metrics /tmp/flash_smoke.jsonl
 	python scripts/obs_report.py /tmp/flash_smoke.jsonl --validate --require flash --out /tmp/flash_smoke_summary.json
 	python scripts/perf_gate.py /tmp/flash_smoke.jsonl
+
+assembly-smoke:    ## kNN-free large-assembly serving gate (docs/PERFORMANCE.md "Large assemblies"): global-vs-materialized parity + equivariance<=1e-5 on identical params, n=4096 SERVED through an AOT InferenceEngine global bucket (zero post-warmup compiles, oversize reject carries max_bucket), sp=2 ring arm proven all-gather-free from its partitioned HLO, >=3x streaming-vs-materialized peak-HBM off the cost ledger, schema'd assembly record judged by the committed budgets; then the --inject-regression arm must exit rc==1, proving those budgets fire
+	rm -f /tmp/assembly_smoke.jsonl
+	python scripts/assembly_smoke.py --metrics /tmp/assembly_smoke.jsonl
+	python scripts/obs_report.py /tmp/assembly_smoke.jsonl --validate --require assembly --out /tmp/assembly_smoke_summary.json
+	python scripts/perf_gate.py /tmp/assembly_smoke.jsonl
+	rm -f /tmp/assembly_inject.jsonl
+	python scripts/assembly_smoke.py --metrics /tmp/assembly_inject.jsonl --inject-regression >/tmp/assembly_inject.log 2>&1; test $$? -eq 1 || { echo "assembly-smoke injected arm did NOT fire with rc=1 — a vanished memory win / broken equivariance / unserved bucket went undetected; output:"; cat /tmp/assembly_inject.log; exit 1; }  # rc=1 is the committed budgets FIRING on the corrupted record; any other rc (crash, argparse, rc=2 budgets-not-wired) fails loudly with the evidence
 
 chaos-smoke:       ## fault-domain gate (docs/ROBUSTNESS.md): seeded replica crashes + latency spikes + a torn latest checkpoint + one rolling swap over 3 CPU replicas — zero lost requests, >=1 observed quarantine->recovery, swap restores the FALLBACK step, schema'd fault records (--require fault), judged by the chaos perf budgets; then the WEAKENED arm (a fault class made droppable) must exit rc==1, proving the zero-lost gate fires
 	rm -f /tmp/chaos_smoke.jsonl

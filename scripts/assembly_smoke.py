@@ -1,0 +1,382 @@
+"""CPU gate for kNN-free large-assembly serving (`make assembly-smoke`).
+
+The ISSUE 18 acceptance harness for `attention_mode='global'`: a large
+assembly must be SERVED — through a real `InferenceEngine` bucket, not a
+bare `module.apply` — with O(n) activation memory, and every claim must
+land in one schema'd `assembly` record that PERF_BUDGETS.json judges.
+
+Five gates, exit non-zero on any failure:
+
+  1. PARITY — the streaming global path vs the `global_materialize=True`
+     control arm (every [b, n, n, ...] per-edge tensor in memory, plain
+     autodiff) on IDENTICAL parameters, both contraction arms (dense CG
+     and so2 banded), under a real node mask (padded rows), at an n
+     large enough that the stream genuinely chunks. <= 1e-4 max-abs.
+  2. EQUIVARIANCE — the streaming global model's equivariance L2 must
+     stay under 1e-5 (tighter than the flash gate: the global path has
+     no neighbor-selection discretization to hide behind).
+  3. SHARDED — a fresh 2-virtual-device subprocess compiles the
+     sequence-parallel ('ring') global arm and proves it ALL-GATHER-FREE
+     via `analyze_hlo_comm` on the partitioned HLO (the PR 11 residue:
+     the flash gather used to bypass the exchange scope), plus parity
+     vs the unsharded stream.
+  4. SERVED — n=4096 (the first large-assembly bucket) goes through an
+     AOT `InferenceEngine` global bucket end to end: warmup compiles,
+     one real padded request is answered, ZERO post-warmup compiles,
+     and the oversize rejection carries the client-actionable
+     `max_bucket`. The bucket's peak activation HBM comes off the PR 6
+     cost ledger of the SERVING executable.
+  5. MEMORY — the materialized control arm at the same n is
+     compile-ONLY (AOT lower+compile; XLA's static peak estimate —
+     nothing is executed, which is the point: on most hosts the
+     materialized arm cannot run at 4096 at all). The ledger ratio
+     materialized/global must clear the >=3x floor — enforced by
+     scripts/perf_gate.py over the banked ASSEMBLY_SWEEP.jsonl.
+
+`--inject-regression` writes a corrupted record (ratio 1.0, failed
+equivariance, zero rows served, post-warmup compiles) and requires
+`perf_gate.py` to FIRE on it, then exits 1 — proving the committed
+budgets actually bite (the Makefile asserts rc==1).
+
+    python scripts/assembly_smoke.py [--metrics ASSEMBLY.jsonl]
+        [--bucket 4096] [--parity-n 96] [--sp-n 64]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import uuid
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+PARITY_TOL = 1e-4
+EQ_TOL = 1e-5
+
+MODULE_KW = dict(num_tokens=24, dim=8, depth=1, num_degrees=2,
+                 output_degrees=2, reduce_dim_out=True, attend_self=True,
+                 use_null_kv=True, heads=2, dim_head=8, pallas=False,
+                 attention_mode='global')
+
+
+def _build(backend='dense', **overrides):
+    from se3_transformer_tpu.models.se3_transformer import (
+        SE3TransformerModule,
+    )
+    return SE3TransformerModule(**{**MODULE_KW, 'conv_backend': backend,
+                                   **overrides})
+
+
+def _init_params(mod, feats, coors, mask):
+    import jax
+    return jax.jit(mod.init, static_argnames=('return_type',))(
+        jax.random.PRNGKey(0), feats, coors, mask=mask,
+        return_type=1)['params']
+
+
+def _toy_batch(n, seed=0):
+    import jax.numpy as jnp
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    feats = jnp.asarray(rng.randint(0, 24, (1, n)))
+    coors = jnp.asarray(np.cumsum(rng.normal(size=(1, n, 3)), axis=1),
+                        jnp.float32)
+    return feats, coors
+
+
+def sp_child(n: int) -> int:
+    """Runs in a fresh process under XLA_FLAGS virtual devices: compile
+    the sp=2 ring global arm, analyze its partitioned HLO, check parity
+    vs the unsharded stream. Prints ONE JSON line for the parent."""
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from se3_transformer_tpu.parallel.exchange import analyze_hlo_comm
+
+    devices = jax.devices('cpu')
+    assert len(devices) >= 2, \
+        f'sp child needs 2 virtual devices, got {len(devices)}'
+    mesh = Mesh(np.array(devices[:2]), ('sp',))
+
+    feats, coors = _toy_batch(n)
+    mask = jnp.ones((1, n), bool)
+    plain = _build()
+    params = _init_params(plain, feats, coors, mask)
+    ref = plain.apply({'params': params}, feats, coors, mask=mask,
+                      return_type=1)
+
+    ring = _build(sequence_parallel='ring', mesh=mesh)
+
+    def fn(f, c, m):
+        return ring.apply({'params': params}, f, c, mask=m,
+                          return_type=1)
+
+    # the output stays sharded along the node axis — sequence-parallel
+    # serving hands each host its own rows; re-replicating here would
+    # itself be the full-width gather the gate exists to forbid
+    compiled = jax.jit(
+        fn, out_shardings=NamedSharding(mesh, P(None, 'sp')),
+    ).lower(feats, coors, mask).compile()
+    analysis = analyze_hlo_comm(compiled.as_text(), full_width_dim=n)
+    out = np.asarray(jax.device_get(compiled(feats, coors, mask)))
+    parity = float(np.abs(out - np.asarray(ref)).max())
+    print(json.dumps(dict(
+        sp=2, n=n, parity=parity,
+        all_gather_free=analysis['all_gather_free'],
+        full_width_all_gathers=analysis['full_width_all_gathers'],
+        collectives={k: v.get('count') for k, v in
+                     analysis['collectives'].items()})))
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='kNN-free global-attention large-assembly serving '
+                    'gate: parity + equivariance + sharded HLO proof + '
+                    'engine-served bucket + ledger memory ratio')
+    ap.add_argument('--metrics', default=None,
+                    help='write the schema-valid assembly stream here')
+    ap.add_argument('--bucket', type=int, default=4096,
+                    help='the large-assembly engine bucket to serve')
+    ap.add_argument('--parity-n', type=int, default=96,
+                    help='node count for the parity/equivariance stage '
+                         '(>=32 so the stream genuinely chunks)')
+    ap.add_argument('--sp-n', type=int, default=64)
+    ap.add_argument('--sp-child', type=int, default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument('--inject-regression', action='store_true',
+                    help='write a corrupted record and require the perf '
+                         'gate to fire on it (exits 1 when it does)')
+    args = ap.parse_args(argv)
+
+    if args.sp_child is not None:
+        return sp_child(args.sp_child)
+
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+    import numpy as np
+
+    from se3_transformer_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+    run_id = f'assembly_smoke_{uuid.uuid4().hex[:8]}'
+
+    if args.inject_regression:
+        return inject_regression(args, run_id)
+
+    ok = True
+    n = args.parity_n
+    feats, coors = _toy_batch(n)
+    # padded batch: trailing rows are mask=False — parity must hold on
+    # the real rows with the pad excluded from every pair reduction
+    mask = jnp.asarray(np.arange(n) < n - 7)[None]
+
+    # ---- 1/2: parity (both arms) + equivariance, identical params ---- #
+    from se3_transformer_tpu.utils.validation import equivariance_l2
+    eq = None
+    for backend in ('dense', 'so2'):
+        stream = _build(backend)
+        ctrl = _build(backend, global_materialize=True)
+        params = _init_params(stream, feats, coors, mask)
+        out = stream.apply({'params': params}, feats, coors, mask=mask,
+                           return_type=1)
+        ref = ctrl.apply({'params': params}, feats, coors, mask=mask,
+                         return_type=1)
+        diff = float(jnp.abs(out - ref).max())
+        print(f'{backend}-arm global stream vs materialized parity: '
+              f'{diff:.3g}')
+        if not diff < PARITY_TOL:
+            print(f'FAIL: {backend}-arm parity {diff} >= {PARITY_TOL}')
+            ok = False
+        if backend == 'dense':
+            parity = diff
+            eq = equivariance_l2(stream, params, feats, coors, mask)
+            print(f'global-mode equivariance L2: {eq:.3g}')
+            if not eq < EQ_TOL:
+                print(f'FAIL: equivariance {eq} >= {EQ_TOL}')
+                ok = False
+
+    # ---- 3: sp=2 ring composition, all-gather-free by HLO ---------- #
+    env = dict(os.environ,
+               XLA_FLAGS=(os.environ.get('XLA_FLAGS', '')
+                          + ' --xla_force_host_platform_device_count=2'),
+               JAX_PLATFORMS='cpu')
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         '--sp-child', str(args.sp_n)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        print(f'FAIL: sp child exited {proc.returncode}')
+        return 1
+    sp = json.loads(proc.stdout.strip().splitlines()[-1])
+    print(f'sp=2 ring global arm: parity {sp["parity"]:.3g}, '
+          f'collectives {sp["collectives"]}, '
+          f'all_gather_free={sp["all_gather_free"]}')
+    if not sp['all_gather_free']:
+        print(f'FAIL: sharded global arm re-materialized full-width '
+              f'operands: {sp["full_width_all_gathers"]}')
+        ok = False
+    if not sp['parity'] < PARITY_TOL:
+        print(f'FAIL: sharded parity {sp["parity"]} >= {PARITY_TOL}')
+        ok = False
+
+    # ---- 4: SERVE n through a real engine bucket ------------------- #
+    from se3_transformer_tpu.inference.admission import RequestRejected
+    from se3_transformer_tpu.inference.engine import InferenceEngine
+
+    bucket = args.bucket
+    stream = _build()
+    params = _init_params(stream, feats, coors, mask)
+    engine = InferenceEngine(
+        stream, params, buckets=(bucket,), batch_size=1, return_type=1,
+        # chain adjacency is a kNN-trunk concept; the global mode's
+        # admission contract is purely bucket-shaped
+        with_chain_adjacency=False)
+    compiles_at_warmup = len(engine.compile_seconds)
+    served_len = bucket - 57    # a real (non-bucket-exact) request
+    tokens = np.random.RandomState(1).randint(0, 24, served_len)
+    coords = np.cumsum(
+        np.random.RandomState(1).normal(size=(served_len, 3)),
+        axis=0).astype(np.float32)
+    out = engine.predict(tokens, coords)
+    assert out.shape[0] == served_len, out.shape
+    if not np.isfinite(out).all():
+        print('FAIL: served output is not finite')
+        ok = False
+    post_warmup_compiles = len(engine.compile_seconds) - compiles_at_warmup
+    stats = engine.stats()
+    bucket_served = stats['rows_served'].get(str(bucket), 0)
+    key = (bucket, 1, 'float32')
+    global_peak = int(engine.cost_payloads[key]['peak_bytes'])
+    print(f'engine served n={served_len} through bucket {bucket}: '
+          f'rows_served={bucket_served}, '
+          f'post_warmup_compiles={post_warmup_compiles}, '
+          f'peak_bytes={global_peak}')
+    if bucket_served < 1:
+        print('FAIL: no rows served through the large-assembly bucket')
+        ok = False
+    if post_warmup_compiles != 0:
+        print(f'FAIL: {post_warmup_compiles} post-warmup compiles — the '
+              f'serving cliff the AOT bucket exists to prevent')
+        ok = False
+    try:
+        engine.predict(np.zeros(bucket + 511, np.int32),
+                       np.zeros((bucket + 511, 3), np.float32))
+        print('FAIL: oversize request was not rejected')
+        ok = False
+    except RequestRejected as e:
+        if e.detail.get('max_bucket') != bucket:
+            print(f'FAIL: oversize rejection lacks actionable '
+                  f'max_bucket: {e.detail}')
+            ok = False
+        else:
+            print(f'oversize rejection carries max_bucket='
+                  f'{e.detail["max_bucket"]}')
+
+    # ---- 5: materialized control arm, compile-ONLY ----------------- #
+    from se3_transformer_tpu.observability.costs import cost_payload
+    ctrl = _build(global_materialize=True)
+
+    def ctrl_fn(p, t, c, m):
+        return ctrl.apply({'params': p}, t, c, mask=m, return_type=1)
+
+    def sds(a):
+        return jax.ShapeDtypeStruct(np.shape(a),
+                                    getattr(a, 'dtype', np.float32))
+
+    abstract_params = jax.tree_util.tree_map(sds, params)
+    compiled = jax.jit(ctrl_fn).lower(
+        abstract_params,
+        jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+        jax.ShapeDtypeStruct((1, bucket, 3), jnp.float32),
+        jax.ShapeDtypeStruct((1, bucket), jnp.bool_)).compile()
+    mat_cost = cost_payload(compiled,
+                            label=f'assembly_materialized,n={bucket}')
+    mat_peak = int(mat_cost['peak_bytes'])
+    ratio = round(mat_peak / max(global_peak, 1), 3)
+    print(f'peak activation HBM at n={bucket}: streaming {global_peak} '
+          f'vs materialized {mat_peak} (ratio {ratio}x; the >=3x floor '
+          f'is enforced by scripts/perf_gate.py)')
+
+    if args.metrics:
+        from se3_transformer_tpu.observability.report import (
+            write_record_stream,
+        )
+        from se3_transformer_tpu.observability.schema import (
+            validate_stream,
+        )
+        body = dict(
+            kind='assembly',
+            label=f'global_serving,n={bucket},dim={MODULE_KW["dim"]}',
+            n=served_len, bucket=bucket,
+            global_peak_bytes=global_peak,
+            materialized_peak_bytes=mat_peak,
+            hbm_materialized_vs_global=ratio,
+            parity_linf=parity, equivariance_l2=eq,
+            bucket_served=int(bucket_served),
+            post_warmup_compiles=int(post_warmup_compiles),
+            sp=2, sp_all_gather_free=bool(sp['all_gather_free']),
+            sp_parity_linf=sp['parity'],
+            max_bucket_rejection=True,
+            cost=dict(serving=engine.cost_payloads[key],
+                      materialized=mat_cost))
+        write_record_stream(args.metrics, run_id, [body])
+        info = validate_stream(args.metrics)
+        print(f'schema ok: {info["records"]} records {info["kinds"]}')
+
+    summary = dict(ok=ok, bucket=bucket, served=int(bucket_served),
+                   post_warmup_compiles=int(post_warmup_compiles),
+                   hbm_materialized_vs_global=ratio,
+                   parity_linf=parity, equivariance_l2=eq,
+                   sp_all_gather_free=bool(sp['all_gather_free']))
+    print(json.dumps(summary))
+    return 0 if ok else 1
+
+
+def inject_regression(args, run_id):
+    """Write a corrupted assembly record and require the committed
+    budgets to fire on it. Exits 1 when the gate bites (the Makefile
+    asserts exactly that), 2 when the corruption goes UNDETECTED."""
+    assert args.metrics, '--inject-regression needs --metrics'
+    from se3_transformer_tpu.observability.report import (
+        write_record_stream,
+    )
+    body = dict(
+        kind='assembly', label='global_serving,INJECTED',
+        n=args.bucket - 57, bucket=args.bucket,
+        # the three regressions the budgets exist to catch: the memory
+        # win gone (ratio 1.0), equivariance broken, nothing actually
+        # served (plus the serving-cliff compile, which obs_report's
+        # --require assembly gate also rejects)
+        global_peak_bytes=1 << 30, materialized_peak_bytes=1 << 30,
+        hbm_materialized_vs_global=1.0,
+        parity_linf=0.5, equivariance_l2=0.5,
+        bucket_served=0, post_warmup_compiles=3)
+    write_record_stream(args.metrics, run_id, [body])
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, 'perf_gate.py'),
+         args.metrics],
+        capture_output=True, text=True, cwd=REPO)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode == 0:
+        print('INJECTED REGRESSION NOT CAUGHT: perf_gate passed a '
+              'record with ratio 1.0, broken equivariance, and zero '
+              'rows served — the budgets are not wired')
+        return 2
+    print('perf gate FIRED on the injected assembly regression '
+          f'(rc={proc.returncode}) — budgets are live')
+    return 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -469,6 +469,45 @@ def _gate_slo(records):
     return True
 
 
+def _gate_assembly(records):
+    recs = [r for r in records if r.get('kind') == 'assembly']
+    if not recs:
+        print('ASSEMBLY GATE: no assembly records in the stream (was '
+              'scripts/assembly_smoke.py run?)', file=sys.stderr)
+        return False
+    last = recs[-1]
+    if not last.get('bucket_served'):
+        print('ASSEMBLY GATE: zero rows served through the engine '
+              'bucket — the record proves nothing about serving',
+              file=sys.stderr)
+        return False
+    if last.get('post_warmup_compiles'):
+        print(f'ASSEMBLY GATE: {last["post_warmup_compiles"]} '
+              f'post-warmup compile(s) — the AOT bucket executable '
+              f'was not actually reused', file=sys.stderr)
+        return False
+    parity = last.get('parity_linf')
+    if not isinstance(parity, (int, float)) or parity >= 1e-4:
+        print(f'ASSEMBLY GATE: global-vs-materialized parity '
+              f'{parity!r} >= 1e-4 (or missing) — the streaming arm '
+              f'diverged from the all-pairs reference', file=sys.stderr)
+        return False
+    ratio = last.get('hbm_materialized_vs_global')
+    if not isinstance(ratio, (int, float)) or ratio <= 0:
+        print(f'ASSEMBLY GATE: degenerate hbm_materialized_vs_global '
+              f'{ratio!r} — the record proves no memory claim',
+              file=sys.stderr)
+        return False
+    print(f'assembly gate ok: {len(recs)} assembly records, '
+          f'n={last.get("n")} served via bucket {last.get("bucket")} '
+          f'({last.get("bucket_served")} rows, zero post-warmup '
+          f'compiles), parity {parity:.2e}, eq '
+          f'{last.get("equivariance_l2")}, materialized/global HBM '
+          f'{ratio} (the >=3x floor and the equivariance ceiling are '
+          f'enforced by scripts/perf_gate.py)', file=sys.stderr)
+    return True
+
+
 _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       tune=_gate_tune, cost=_gate_cost,
                       profile=_gate_profile, serve=_gate_serve,
@@ -476,7 +515,8 @@ _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       v2_sweep=_gate_v2_sweep, flash=_gate_flash,
                       fault=_gate_fault, guard=_gate_guard,
                       fleet=_gate_fleet, quant_ab=_gate_quant_ab,
-                      trace=_gate_trace, slo=_gate_slo)
+                      trace=_gate_trace, slo=_gate_slo,
+                      assembly=_gate_assembly)
 
 
 def main(argv=None):
@@ -509,7 +549,9 @@ def main(argv=None):
                          'zero lost requests fleet-wide; trace: at '
                          'least one complete span tree and zero '
                          'orphan spans; slo: nonzero answered and a '
-                         'numeric availability) '
+                         'numeric availability; assembly: rows served '
+                         'through an engine bucket with zero '
+                         'post-warmup compiles and sub-1e-4 parity) '
                          'and exits non-zero on failure')
     # legacy aliases for the unified --require flag (kept: Makefiles and
     # session scripts in the wild still pass them)

@@ -82,12 +82,16 @@ DEFAULT_BUDGETS = os.path.join(REPO, 'PERF_BUDGETS.json')
 # the fleet availability floor and the trace-completeness invariant
 # (every resolved request = one complete single-root span tree) are
 # judged by a plain `make perf-gate`.
+# ASSEMBLY_SWEEP.jsonl: the banked `make assembly-smoke` kNN-free
+# large-assembly stream, so the >=3x streaming-vs-materialized peak-HBM
+# floor at the 4096 bucket, the tightened global equivariance ceiling,
+# and the served-through-an-engine-bucket proof bit are judged too.
 DEFAULT_RECORDS = ('BENCH_r05.json', 'WIDTH_TABLE.jsonl',
                    'SERVE_MULTI.jsonl', 'SO2_SWEEP.jsonl',
                    'FLASH_AB.jsonl', 'CHAOS_SMOKE.jsonl',
                    'QUANT_AB.jsonl', 'TRAIN_CHAOS.jsonl',
                    'FLEET_CHAOS.jsonl', 'SLO_SMOKE.jsonl',
-                   'V2_SWEEP.jsonl')
+                   'V2_SWEEP.jsonl', 'ASSEMBLY_SWEEP.jsonl')
 
 
 # --------------------------------------------------------------------- #

@@ -100,20 +100,38 @@ def _build_step(args):
         # 'flash_stream' kinds become tuning targets; --pallas-attention
         # enables the per-degree fused attention kernel so 'attention'
         # AND 'attention_bwd' picks resolve in the traced train step
-        module = SE3TransformerModule(
-            num_tokens=24, dim=dim, dim_head=8, heads=2, depth=1,
-            attend_self=True, input_degrees=1, num_degrees=2,
-            output_degrees=2, reduce_dim_out=True,
-            differentiable_coors=True, num_neighbors=8,
-            pallas=True, pallas_interpret=True,
-            fuse_basis=args.fuse_basis,
-            fuse_pairwise=args.fuse_pairwise,
-            flash_interpret=args.fuse_pairwise,
-            shared_radial_hidden=args.fuse_pairwise,
-            pallas_attention=args.pallas_attention or None,
-            pallas_attention_interpret=args.pallas_attention,
-            conv_backend=args.conv_backend)
-        label = f'smoke,dim={dim},interpret,{args.conv_backend}'
+        if args.attention_mode == 'global':
+            # the kNN-free step: the XLA streaming dispatch consults
+            # the 'flash_global' chunk kind directly on CPU (pallas
+            # off — the global kernel's stream fallback IS the CPU
+            # path), no interpret-mode kernels needed
+            assert not (args.fuse_pairwise or args.fuse_basis
+                        or args.pallas_attention), \
+                '--attention-mode global subsumes the fused-attention ' \
+                'flags (the global path always streams)'
+            module = SE3TransformerModule(
+                num_tokens=24, dim=dim, dim_head=8, heads=2, depth=1,
+                attend_self=True, input_degrees=1, num_degrees=2,
+                output_degrees=2, reduce_dim_out=True,
+                differentiable_coors=True, pallas=False,
+                attention_mode='global',
+                conv_backend=args.conv_backend)
+            label = f'smoke,dim={dim},global,{args.conv_backend}'
+        else:
+            module = SE3TransformerModule(
+                num_tokens=24, dim=dim, dim_head=8, heads=2, depth=1,
+                attend_self=True, input_degrees=1, num_degrees=2,
+                output_degrees=2, reduce_dim_out=True,
+                differentiable_coors=True, num_neighbors=8,
+                pallas=True, pallas_interpret=True,
+                fuse_basis=args.fuse_basis,
+                fuse_pairwise=args.fuse_pairwise,
+                flash_interpret=args.fuse_pairwise,
+                shared_radial_hidden=args.fuse_pairwise,
+                pallas_attention=args.pallas_attention or None,
+                pallas_attention_interpret=args.pallas_attention,
+                conv_backend=args.conv_backend)
+            label = f'smoke,dim={dim},interpret,{args.conv_backend}'
     else:
         num_nodes = args.nodes or 1024
         overrides = dict(output_degrees=2, reduce_dim_out=True)
@@ -122,6 +140,8 @@ def _build_step(args):
                              shared_radial_hidden=True)
         if args.pallas_attention:
             overrides['pallas_attention'] = True
+        if args.attention_mode == 'global':
+            overrides['attention_mode'] = 'global'
         module = recipes.RECIPES[args.recipe](dim=args.dim, **overrides)
         label = f'{args.recipe},dim={args.dim}'
 
@@ -222,7 +242,7 @@ def main(argv=None):
     ap.add_argument('--kinds', nargs='+',
                     default=['plain', 'bx', 'bxf', 'attention',
                              'attention_bwd', 'so2', 'flash',
-                             'flash_stream'])
+                             'flash_stream', 'flash_global'])
     ap.add_argument('--conv-backend', default='dense',
                     help="smoke module's conv backend ('dense'|'so2');"
                          " 'so2' makes the banded contraction's chunk "
@@ -244,6 +264,11 @@ def main(argv=None):
                     help='enable the per-degree fused attention kernel '
                          "so the 'attention' and 'attention_bwd' kinds "
                          'become tuning targets')
+    ap.add_argument('--attention-mode', default='knn',
+                    choices=('knn', 'global'),
+                    help="'global' traces the kNN-free large-assembly "
+                         "step so the 'flash_global' stream-chunk kind "
+                         'becomes a tuning target')
     args = ap.parse_args(argv)
 
     if args.smoke:

@@ -48,13 +48,19 @@ def fit_bucket(buckets, length: int):
 
 
 def oversize_error(length: int, max_len: int) -> 'RequestRejected':
-    """THE oversize rejection payload (one constructor, three raisers)."""
+    """THE oversize rejection payload (one constructor, three raisers).
+
+    `max_bucket` duplicates `max_len` under the name clients reason in:
+    a 30k-atom submitter reads the largest configured bucket straight
+    off the structured detail (actionable — split the assembly or ask
+    for a bigger deployment) instead of parsing the prose."""
     return RequestRejected(
         OVERSIZE,
         f'request length {length} exceeds the largest compiled bucket '
         f'({max_len}); recompile the engine with a larger bucket to '
         f'serve it',
-        length=int(length), max_len=int(max_len))
+        length=int(length), max_len=int(max_len),
+        max_bucket=int(max_len))
 
 
 class RequestRejected(Exception):

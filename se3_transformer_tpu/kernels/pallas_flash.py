@@ -333,6 +333,25 @@ def _safe_dist(rel: jnp.ndarray, eps: float = 1e-8) -> jnp.ndarray:
     return jnp.sqrt(jnp.maximum(jnp.sum(rel ** 2, axis=-1), eps ** 2))
 
 
+def _global_edge_payload(cfg: 'FlashConfig', rel, rp_v, rp_k=None):
+    """Everything the global (graph-free) tile computes on the fly from
+    a [..., 3] rel_pos block: the radial hiddens through the inlined
+    Dense-LN-GELU trunk and the harmonics/frames payload the active
+    arms need. Shared by the XLA stream's chunk body, the Pallas kernel
+    body, and the ring-sharded fold so the three dispatches stay one
+    function by construction."""
+    ef = _safe_dist(rel)[..., None]
+    h_v = _radial_apply(ef, rp_v)
+    h_k = _radial_apply(ef, rp_k) if rp_k is not None else h_v
+    sh = flash_sh_payload(rel, _sh_degree(cfg), differentiable=True) \
+        if 'dense' in (cfg.arm_v, cfg.arm_k) else None
+    fr = None
+    if 'so2' in (cfg.arm_v, cfg.arm_k):
+        from ..so2.frames import edge_frames
+        fr = edge_frames(rel, _frame_degree(cfg), differentiable=True)
+    return h_v, h_k, sh, fr
+
+
 # --------------------------------------------------------------------- #
 # online softmax
 # --------------------------------------------------------------------- #
@@ -485,33 +504,42 @@ def _pick_flash_blocks(shape, dtype: str) -> Tuple[int, int]:
     return bn, bj
 
 
-def _pick_stream_chunks(shape, dtype: str) -> int:
+def _pick_stream_chunks(shape, dtype: str,
+                        kind: str = 'flash_stream') -> int:
     """Node-chunk count for the XLA streaming path (and the backward's
     recompute replay). Heuristic: ~16-node chunks — measured best on
     the CPU toy A/B sweep (SE3_TPU_FLASH_CHUNKS 1/2/4/8/16: 8 chunks
     at n=128 beat 4 on BOTH step time and peak bytes; 1 = unchunked
     loses the memory win entirely), small enough that the per-chunk
-    edge tensors stay cache-sized."""
+    edge tensors stay cache-sized.
+
+    `kind` keys the measured table: 'flash_stream' for the kNN stream,
+    'flash_global' for the graph-free variant, whose per-chunk working
+    set is O(rows * n) rather than O(rows * K) — at assembly n the
+    small-n-calibrated n // 16 hard-code is exactly what the measured
+    table exists to override (its candidate ladder extends to 2048
+    chunks, tuning.admissible_candidates)."""
     from . import tuning
     env = os.environ.get('SE3_TPU_FLASH_CHUNKS', '')
     if env:
         chunks = max(1, int(env))
-        tuning.record_consult('flash_stream', shape, dtype, 'env',
-                              (chunks,))
+        tuning.record_consult(kind, shape, dtype, 'env', (chunks,))
         return chunks
-    hit = tuning.lookup('flash_stream', shape, dtype=dtype)
+    hit = tuning.lookup(kind, shape, dtype=dtype)
     if hit is not None:
         blocks, source = hit
         if source == 'forced' or tuning.validate_entry(
-                'flash_stream', shape, blocks):
-            tuning.record_consult('flash_stream', shape, dtype, source,
-                                  blocks)
+                kind, shape, blocks):
+            tuning.record_consult(kind, shape, dtype, source, blocks)
             return int(blocks[0])
     n = int(shape[0])
     chunks = max(1, n // 16)
-    tuning.record_consult('flash_stream', shape, dtype, 'heuristic',
-                          (chunks,))
+    tuning.record_consult(kind, shape, dtype, 'heuristic', (chunks,))
     return chunks
+
+
+def _stream_kind(cfg: 'FlashConfig') -> str:
+    return 'flash_global' if cfg.mode == 'global' else 'flash_stream'
 
 
 def _shape_key(cfg: FlashConfig, ops) -> Tuple[int, ...]:
@@ -571,23 +599,15 @@ def _chunk_body(cfg: FlashConfig, chunk, full):
         ci = chunk['coords']                    # [B, nc, 3]
         cj = full['coords']                     # [B, n, 3]
         rel = ci[:, :, None, :] - cj[:, None, :, :]
-        dist = _safe_dist(rel)
-        ef = dist[..., None]
-        h_v = _radial_apply(ef, full['rp_v'])
-        h_k = _radial_apply(ef, full['rp_k']) if 'rp_k' in full else h_v
-        sh = flash_sh_payload(rel, _sh_degree(cfg), differentiable=True) \
-            if 'dense' in (cfg.arm_v, cfg.arm_k) else None
-        fr = None
-        if 'so2' in (cfg.arm_v, cfg.arm_k):
-            from ..so2.frames import edge_frames
-            fr = edge_frames(rel, _frame_degree(cfg), differentiable=True)
+        h_v, h_k, sh, fr = _global_edge_payload(
+            cfg, rel, full['rp_v'], full.get('rp_k'))
         xg = tuple(jnp.broadcast_to(x[:, None], (x.shape[0], q.shape[1],
                                                  *x.shape[1:]))
                    for x in full['xs'])
         nmask = None
         if 'nodemask' in full:
             nmask = jnp.broadcast_to(full['nodemask'][:, None, :],
-                                     dist.shape)
+                                     rel.shape[:-1])
         if cfg.exclude_self:
             rows = chunk['row_id'][..., None]       # [B, nc, 1]
             cols = jnp.arange(cj.shape[1])[None, None, :]
@@ -720,21 +740,10 @@ def _flash_kernel_body(cfg: FlashConfig, spec, dims, *refs):
         ci = named['coords_i'][0]                  # [bn, 3]
         cj = named['coords_j'][0]                  # [bj, 3]
         rel = ci[:, None, :] - cj[None, :, :]
-        dist = _safe_dist(rel)
-        ef = dist[..., None]
         rp_v = tuple(named[f'rpv{i}'][...] for i in range(8))
-        h_v = _radial_apply(ef, rp_v)
-        if 'rpk0' in named:
-            h_k = _radial_apply(ef, tuple(named[f'rpk{i}'][...]
-                                          for i in range(8)))
-        else:
-            h_k = h_v
-        sh = flash_sh_payload(rel, _sh_degree(cfg), differentiable=True) \
-            if 'dense' in (cfg.arm_v, cfg.arm_k) else None
-        fr = None
-        if 'so2' in (cfg.arm_v, cfg.arm_k):
-            from ..so2.frames import edge_frames
-            fr = edge_frames(rel, _frame_degree(cfg), differentiable=True)
+        rp_k = tuple(named[f'rpk{i}'][...] for i in range(8)) \
+            if 'rpk0' in named else None
+        h_v, h_k, sh, fr = _global_edge_payload(cfg, rel, rp_v, rp_k)
         xg = tuple(
             jnp.broadcast_to(
                 named[f'x{i}'][0].reshape(bj, c, 2 * d + 1)[None],
@@ -962,7 +971,8 @@ def _dispatch(cfg: FlashConfig, ops: dict) -> jnp.ndarray:
             f'flash kernel working set (shape {shape}) exceeds the '
             f'scoped-VMEM budget at every block size; using the XLA '
             f'streaming path', stacklevel=2)
-    chunks = _pick_stream_chunks(shape, jnp.dtype(ops['q'].dtype).name)
+    chunks = _pick_stream_chunks(shape, jnp.dtype(ops['q'].dtype).name,
+                                 kind=_stream_kind(cfg))
     return _flash_stream(cfg, ops, chunks)
 
 
@@ -982,7 +992,8 @@ def _flash_core_bwd(cfg, ops, g):
     # jax.vjp — activations exist one node chunk at a time, composing
     # with the reversible trunk's outer remat for near-O(1) memory
     shape = _shape_key(cfg, ops)
-    chunks = _pick_stream_chunks(shape, jnp.dtype(ops['q'].dtype).name)
+    chunks = _pick_stream_chunks(shape, jnp.dtype(ops['q'].dtype).name,
+                                 kind=_stream_kind(cfg))
     _, vjp = jax.vjp(lambda o: _flash_stream(cfg, o, chunks), ops)
     (dops,) = vjp(g)
     return (dops,)
@@ -1064,13 +1075,21 @@ def flash_global_attention(q, xs, coords, rp_v, wv, bv, *,
                            arm='dense', rp_k=None, wk=None, bk=None,
                            node_mask=None, prefix_k=None, prefix_v=None,
                            exclude_self=True, pallas=None,
-                           interpret=False) -> jnp.ndarray:
+                           interpret=False,
+                           materialize=False) -> jnp.ndarray:
     """Graph-free global equivariant attention (no kNN truncation): every
     node attends to every other node, with rel_pos/rel_dist, the radial
     hidden (rp_* = the 8-tuple Dense-LN-GELU trunk params, 1-D leaves
     reshaped [1, mid]) and the harmonics/frames payload computed on the
     fly per tile — no per-edge tensor ever exists in HBM, activation
-    memory is O(n) at O(n^2) compute. The large-assembly scenario."""
+    memory is O(n) at O(n^2) compute. The large-assembly scenario.
+
+    `materialize=True` is the CONTROL arm: the identical function run as
+    one unchunked pass (every [B, n, n, ...] per-edge tensor in HBM,
+    plain autodiff — no custom_vjp, no recompute). Same params, same
+    math; only the memory story differs. The assembly smoke and
+    bench --assembly A/B the two arms for parity and the peak-HBM
+    ledger claim."""
     tie = wk is None
     cfg = FlashConfig(
         pairs=tuple((int(d), int(c)) for d, c in pairs),
@@ -1079,7 +1098,8 @@ def flash_global_attention(q, xs, coords, rp_v, wv, bv, *,
         prefix=int(prefix_k.shape[2]) if prefix_k is not None else 0,
         has_mask=node_mask is not None, mode='global',
         exclude_self=bool(exclude_self),
-        use_pallas=_resolve_pallas(pallas, interpret),
+        use_pallas=(False if materialize
+                    else _resolve_pallas(pallas, interpret)),
         interpret=interpret)
     rp_v = tuple(p.reshape(1, -1) if p.ndim == 1 else p for p in rp_v)
     ops = dict(q=q, xs=tuple(xs), coords=coords, rp_v=rp_v, wv=wv, bv=bv)
@@ -1091,5 +1111,146 @@ def flash_global_attention(q, xs, coords, rp_v, wv, bv, *,
                               for p in rp_k), wk=wk, bk=bk)
     if prefix_k is not None:
         ops.update(prefix_k=prefix_k, prefix_v=prefix_v)
+    if materialize:
+        # one chunk == the fully-materialized all-pairs computation,
+        # differentiated by plain autodiff (no recompute-in-backward):
+        # the O(n^2)-memory reference the streaming arm is judged against
+        with jax.named_scope('global_attention_materialized'):
+            return _flash_stream(cfg, ops, 1)
     with jax.named_scope('flash_global_attention'):
         return _flash_core(cfg, ops)
+
+
+def flash_global_attention_sharded(q, xs, coords, rp_v, wv, bv, *,
+                                   mesh, pairs, d_out, heads, kv_heads,
+                                   scale, axis_name='sp', overlap=True,
+                                   arm='dense', rp_k=None, wk=None,
+                                   bk=None, node_mask=None,
+                                   prefix_k=None, prefix_v=None,
+                                   exclude_self=True) -> jnp.ndarray:
+    """Sequence-parallel global attention: node axis sharded over the
+    `axis_name` mesh axis, the SOURCE blocks (coords / features / mask)
+    rotated one hop per step via `parallel.ring.ring_scan` while each
+    device folds the visiting block into its rows' online-softmax state.
+    Per-device memory is O(n_local^2) per step and the only collectives
+    are the ring's ppermutes — `analyze_hlo_comm` proves the compiled
+    program free of full-width all-gathers (the PR 11 residue: the
+    flash path used to bypass the ring exchange scope entirely).
+
+    Same argument contract as `flash_global_attention` plus the mesh;
+    bit-compatible results (the fold is `_attend_block`, the same
+    online softmax the kernel and the stream run)."""
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.ring import pcast_varying, ring_scan, shard_map
+    tie = wk is None
+    cfg = FlashConfig(
+        pairs=tuple((int(d), int(c)) for d, c in pairs),
+        d_out=int(d_out), heads=int(heads), kv_heads=int(kv_heads),
+        scale=float(scale), arm_v=arm, arm_k=arm, tie=tie,
+        prefix=int(prefix_k.shape[2]) if prefix_k is not None else 0,
+        has_mask=node_mask is not None, mode='global',
+        exclude_self=bool(exclude_self))
+    rp_v = tuple(p.reshape(1, -1) if p.ndim == 1 else p for p in rp_v)
+    if rp_k is not None:
+        rp_k = tuple(p.reshape(1, -1) if p.ndim == 1 else p for p in rp_k)
+    n = q.shape[1]
+    sp = mesh.shape[axis_name]
+    assert n % sp == 0, f'n={n} must divide over {axis_name}={sp}'
+    if node_mask is None:
+        node_mask = jnp.ones(coords.shape[:2], bool)
+
+    row = lambda ndim: P(None, axis_name, *([None] * (ndim - 2)))  # noqa: E731
+    sharded = [q, coords, node_mask, *xs]
+    in_specs = [row(a.ndim) for a in sharded]
+    n_xs = len(xs)
+    has_prefix = prefix_k is not None
+    if has_prefix:
+        sharded += [prefix_k, prefix_v]
+        in_specs += [row(4), row(4)]
+    # weights replicated on every device (the ring rotates activations,
+    # never parameters)
+    repl = [*rp_v, wv, bv]
+    if not tie:
+        assert rp_k is not None, 'untied keys need their radial params'
+        repl += [*rp_k, wk, bk]
+    in_specs += [P()] * len(repl)
+
+    def local(q, coords, nmask, *rest):
+        xs_l = rest[:n_xs]
+        rest = rest[n_xs:]
+        if has_prefix:
+            pk, pv = rest[0], rest[1]
+            rest = rest[2:]
+        else:
+            pk = pv = None
+        rpv = rest[:8]
+        rest = rest[8:]
+        wv_l, bv_l = rest[0], rest[1]
+        rest = rest[2:]
+        rpk = wk_l = bk_l = None
+        if not tie:
+            rpk = rest[:8]
+            wk_l, bk_l = rest[8], rest[9]
+        return _global_sharded_local(
+            cfg, q, xs_l, coords, nmask, pk, pv, rpv, rpk, wv_l, bv_l,
+            wk_l, bk_l, axis_name=axis_name, overlap=overlap,
+            pcast=pcast_varying, ring=ring_scan)
+
+    fn = shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=row(4))
+    with jax.named_scope('flash_global_attention_sharded'):
+        return fn(*sharded, *repl)
+
+
+def _global_sharded_local(cfg, q, xs, coords, nmask, prefix_k, prefix_v,
+                          rp_v, rp_k, wv, bv, wk, bk, *, axis_name,
+                          overlap, pcast, ring):
+    """Per-shard body: every operand is this device's row block.
+    Queries stay pinned; (coords, mask, features) rotate as the source
+    blocks. Exactly sp ppermutes per operand, no other collectives."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, nl = q.shape[:2]
+    Dh = q.shape[-1]
+    kv_h = cfg.kv_heads
+    group = cfg.heads // kv_h
+    qr = q.reshape(b, nl, kv_h, group, Dh)
+    if prefix_k is not None:
+        S0 = cfg.prefix
+        pk = prefix_k.reshape(b, nl, S0, kv_h, Dh)
+        pv = prefix_v.reshape(b, nl, S0, kv_h, Dh)
+    else:
+        pk = pv = None
+    m, l, acc = _init_state(qr, pk, pv, cfg.scale, Dh)
+    m, l, acc = (pcast(t, axis_name) for t in (m, l, acc))
+    consts = {k: jnp.asarray(v, jnp.float32)
+              for k, v in _arm_consts(cfg).items()}
+    row_gid = my_idx * nl + jnp.arange(nl, dtype=jnp.int32)
+
+    def fold(carry, blocks, t):
+        m, l, acc = carry
+        cj, mask_j, *xs_j = blocks
+        owner = (my_idx + t) % axis_size
+        rel = coords[:, :, None, :] - cj[:, None, :, :]
+        h_v, h_k, sh, fr = _global_edge_payload(cfg, rel, rp_v, rp_k)
+        xg = tuple(jnp.broadcast_to(x[:, None], (b, nl, *x.shape[1:]))
+                   for x in xs_j)
+        kv_v = _kv_block(cfg.arm_v, cfg.pairs, cfg.d_out, xg, h_v, sh,
+                         fr, wv, bv, consts).reshape(b, nl, nl, kv_h, Dh)
+        if cfg.tie:
+            kv_k = kv_v
+        else:
+            kv_k = _kv_block(cfg.arm_k, cfg.pairs, cfg.d_out, xg, h_k,
+                             sh, fr, wk, bk,
+                             consts).reshape(b, nl, nl, kv_h, Dh)
+        maskb = jnp.broadcast_to(mask_j[:, None, :], (b, nl, nl)) \
+            if cfg.has_mask else None
+        if cfg.exclude_self:
+            col_gid = owner * nl + jnp.arange(nl, dtype=jnp.int32)
+            notself = (row_gid[:, None] != col_gid[None, :])[None]
+            maskb = notself if maskb is None else (maskb & notself)
+        return _attend_block(qr, kv_k, kv_v, maskb, m, l, acc, cfg.scale)
+
+    m, l, acc = ring(fold, (m, l, acc), (coords, nmask, *xs),
+                     axis_name, overlap=overlap)
+    return (acc / l[..., None]).reshape(b, nl, cfg.heads, Dh)

@@ -61,11 +61,16 @@ CACHE_VERSION = 1
 # contraction's node-axis streaming chunk count
 # (so2/contract.py::_pick_so2_chunks — blocks = (chunks,), 1 =
 # unchunked); 'flash' is the streaming equivariant-attention kernel's
-# (block_n, block_j) tile pair (kernels/pallas_flash.py) and
+# (block_n, block_j) tile pair (kernels/pallas_flash.py),
 # 'flash_stream' its XLA fallback's node-axis chunk count
-# (blocks = (chunks,), 1 = unchunked).
+# (blocks = (chunks,), 1 = unchunked), and 'flash_global' the same
+# chunk-count pick for the graph-free global variant — its own kind
+# because its per-chunk working set is O(rows * n) not O(rows * K),
+# so a small-n kNN-calibrated entry must never steer an assembly-n
+# global step (the Pallas block pick stays kind 'flash': global
+# shapes key K=0 there).
 KINDS = ('plain', 'bx', 'bxf', 'attention', 'attention_bwd', 'so2',
-         'flash', 'flash_stream')
+         'flash', 'flash_stream', 'flash_global')
 
 # Mosaic's scoped-vmem stack limit is ~16 MiB; 12 MiB leaves slack for
 # compiler temporaries (same constant, same hard-won reason, as
@@ -467,6 +472,14 @@ def admissible_candidates(kind: str, shape: Sequence[int]
         # validate_entry would reject larger measured entries as corrupt
         n = int(shape[0])
         out = [(c,) for c in (1, 2, 4, 8, 16, 32, 64, 128) if c <= n]
+    elif kind == 'flash_global':
+        # the global variant's chunk count: same mechanism, ladder
+        # extended through the assembly regime (n // 16 is 2048 chunks
+        # at n=32768 — the heuristic's operating point must stay
+        # admissible or validate_entry rejects measured entries there)
+        n = int(shape[0])
+        out = [(c,) for c in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512,
+                              1024, 2048) if c <= n]
     elif kind == 'so2':
         # node-axis streaming chunk count for the banded SO(2)
         # contraction (so2/contract.py): 1 = unchunked (the heuristic

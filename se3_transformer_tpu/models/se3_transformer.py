@@ -42,6 +42,7 @@ from ..ops.neighbors import (
 from ..ops.rotary import sinusoidal_embeddings
 from ..utils.helpers import (
     batched_index_select, cast_tuple, masked_mean, safe_cat, safe_norm,
+    to_order,
 )
 from ..observability import named_scope
 
@@ -189,6 +190,25 @@ class SE3TransformerModule(nn.Module):
     #                  dense batched_index_select path, exact parity.
     ring_overlap: bool = True
     ring_exchange: bool = True
+    # attention_mode='global': the kNN-free large-assembly mode. No
+    # neighbor selection, no get_basis, no exchange_index_select — every
+    # node attends to every node, with rel_pos/rel_dist, the radial
+    # hidden and the SH/frames payload rebuilt per VMEM tile from raw
+    # coordinates inside the streaming kernel (kernels.pallas_flash
+    # global mode): activation memory is O(n) at O(n^2) compute, the
+    # regime where n=4k-32k assemblies become admissible at all. The
+    # input projection becomes a LinearSE3 lift (zero-filled for hidden
+    # degrees the input lacks), the trunk runs the same attention blocks
+    # in global mode (dense or so2 arm per conv_backend), and the output
+    # projection is a LinearSE3 over the hidden degrees. Composes with
+    # reversible=True and with sequence_parallel='ring' (queries stay
+    # pinned, kv blocks rotate by ppermute — no full-width all-gather;
+    # the ring exchange scope is live on this path).
+    attention_mode: str = 'knn'
+    # the O(n^2)-memory control arm for A/B (bench --assembly /
+    # assembly_smoke): identical params and math, per-edge tensors
+    # fully materialized, plain autodiff
+    global_materialize: bool = False
 
     # checkpoint/capability family stamp (no annotation: NOT a flax
     # field). training/checkpoint.py guards restores on it — a v1
@@ -323,6 +343,19 @@ class SE3TransformerModule(nn.Module):
             f"feature dim {feats['0'].shape[2]} != configured {fiber_in[0]}"
         assert set(map(int, feats.keys())) == set(range(self.input_degrees)), \
             f'input must have degrees 0..{self.input_degrees - 1}'
+
+        # ------------------------------------------------------------- #
+        # kNN-free global attention (attention_mode='global'): branch
+        # before any neighbor budget / O(n^2) index construction — none
+        # of it exists on this path (see the field comment)
+        # ------------------------------------------------------------- #
+        if self.attention_mode == 'global':
+            return self._global_forward(
+                feats, coors, mask, global_feats, return_type,
+                return_pooled, fiber_in, fiber_hidden, fiber_out, b, n)
+        assert self.attention_mode == 'knn', \
+            f'unknown attention_mode {self.attention_mode!r} ' \
+            f"(want 'knn' or 'global')"
 
         # static neighbor budget (reference :1277-1281, made static)
         num_neighbors = self.num_neighbors
@@ -572,6 +605,134 @@ class SE3TransformerModule(nn.Module):
                     sp_full = sparse_neighbor_mask(adj_noself, num_sparse,
                                                    noise_full)
             return adj_mat, adj_ind_full, sp_full, num_sparse
+
+    def _global_forward(self, feats, coors, mask, global_feats,
+                        return_type, return_pooled, fiber_in, fiber_hidden,
+                        fiber_out, b, n):
+        """attention_mode='global' (see the field comment): LinearSE3
+        lift in -> global-attention trunk -> LinearSE3 out, with
+        coordinates riding the basis dict's reserved keys. Shares the
+        output conventions tail with _body verbatim."""
+        import contextlib
+
+        assert not (self.attend_sparse_neighbors or self.causal
+                    or self.num_adj_degrees is not None or self.has_edges
+                    or self.use_egnn), \
+            "attention_mode='global' is plain all-pairs attention: " \
+            'sparse/causal/adjacency/edge/egnn semantics presume a ' \
+            'neighbor list'
+        assert not (self.rotary_position or self.rotary_rel_dist), \
+            'global attention does not support rotary embeddings'
+        assert not self.linear_proj_keys, \
+            'global attention needs conv keys (linear_proj_keys is the ' \
+            'gathered node-projection variant)'
+        assert not self.fourier_encode_dist, \
+            'global attention consumes raw distances only (rebuilt from ' \
+            'coordinates per tile)'
+        assert self.num_conv_layers == 0, \
+            'global mode has no per-edge convs (preconvs are ConvSE3)'
+        assert not any(self._attention_fused()), \
+            "fuse_pairwise is subsumed by attention_mode='global' (this " \
+            'path always streams); leave it False'
+        assert self.remat_policy is None, \
+            "remat_policy='save_conv_outputs' tags ConvSE3 outputs, " \
+            'which the global trunk never materializes — it would ' \
+            'silently no-op'
+        assert not (self.reversible and self.accept_global_feats), \
+            'reversibility and global features are not compatible'
+        if fiber_out is not None:
+            hidden_degrees = {d for d, _ in fiber_hidden}
+            assert all(d in hidden_degrees for d, _ in fiber_out), \
+                'global mode projects out with a LinearSE3 (no per-edge ' \
+                'conv_out), so every output degree must exist in the ' \
+                'hidden fiber'
+
+        backends = self._layer_backends(None)
+        value_backends = tuple(backends.get(f'attn_block{i}/to_v', 'dense')
+                               for i in range(self.depth))
+        key_backends = tuple(backends.get(f'attn_block{i}/to_k', 'dense')
+                             for i in range(self.depth))
+
+        # coordinates (+ node mask) ride the basis dict's reserved keys —
+        # the only "basis" the global kernel consumes. differentiable_coors
+        # gates coordinate gradients exactly like get_basis does.
+        basis = {'global_coords': coors if self.differentiable_coors
+                 else jax.lax.stop_gradient(coors)}
+        if mask is not None:
+            basis['global_mask'] = mask
+
+        # sequence-parallel composition: an ACTIVE exchange scope is the
+        # trace-time signal that routes every attention block to the
+        # ring-sharded global kernel (parallel/exchange.py — the scope
+        # the kNN flash gather used to bypass)
+        scope = contextlib.nullcontext()
+        if self.sequence_parallel is not None:
+            assert self.sequence_parallel == 'ring', \
+                f'unknown sequence_parallel mode {self.sequence_parallel!r}'
+            assert self.mesh is not None, \
+                'sequence_parallel requires a mesh (jax.sharding.Mesh)'
+            from ..parallel.exchange import exchange_scope
+            scope = exchange_scope(self.mesh, overlap=self.ring_overlap)
+
+        # lift in: LinearSE3 emits only degrees present in BOTH fibers —
+        # zero-fill the hidden degrees the input lacks (there is no
+        # per-edge conv_in to synthesize them; the first attention block
+        # populates them through the pairwise SH payload)
+        with named_scope('conv_in'):
+            x = dict(LinearSE3(fiber_in, fiber_hidden,
+                               name='lift_in')(feats))
+            dtype = feats['0'].dtype
+            for degree, c in fiber_hidden:
+                if str(degree) not in x:
+                    x[str(degree)] = jnp.zeros(
+                        (b, n, c, to_order(degree)), dtype)
+
+        with scope:
+            with named_scope('trunk'):
+                x = SequentialTrunk(
+                    fiber_hidden, depth=self.depth, heads=self.heads,
+                    dim_head=self.dim_head, attend_self=self.attend_self,
+                    value_backends=value_backends,
+                    key_backends=key_backends,
+                    attention_mode='global',
+                    global_materialize=self.global_materialize,
+                    flash_interpret=self.flash_interpret,
+                    use_null_kv=self.use_null_kv,
+                    global_feats_dim=self.global_feats_dim,
+                    tie_key_values=self.tie_key_values,
+                    one_headed_key_values=self.one_headed_key_values,
+                    norm_gated_scale=self.norm_gated_scale,
+                    reversible=self.reversible,
+                    pallas=self.pallas,
+                    radial_bf16=self.radial_bf16,
+                    name='trunk')(x, (None, None, None), None, basis,
+                                  global_feats, None, mask)
+
+        if fiber_out is not None:
+            with named_scope('conv_out'):
+                x = LinearSE3(fiber_hidden, fiber_out, name='lift_out')(x)
+
+        if (self.norm_out or self.reversible) and fiber_out is not None:
+            x = NormSE3(fiber_out, gated_scale=self.norm_gated_scale,
+                        nonlin=lambda t: t, name='norm_out')(x)
+
+        final_fiber = fiber_out if fiber_out is not None else fiber_hidden
+        if self.reduce_dim_out:
+            x = LinearSE3(final_fiber, final_fiber.to(1),
+                          name='linear_out')(x)
+            x = {k: v[..., 0, :] for k, v in x.items()}
+
+        x = _permute_degree1(x, _IRREP_TO_CART)
+
+        if return_pooled:
+            pool = (lambda t: masked_mean(t, mask, axis=1)) \
+                if mask is not None else (lambda t: t.mean(axis=1))
+            x = {k: pool(v) for k, v in x.items()}
+        if '0' in x:
+            x = {**x, '0': x['0'][..., 0]}
+        if return_type is not None:
+            return x[str(return_type)]
+        return x
 
     def _attention_fused(self):
         """Per-block streaming-attention resolution from the

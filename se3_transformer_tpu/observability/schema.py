@@ -210,7 +210,7 @@ SCHEMA_VERSION = 1
 KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
                'serve', 'tune', 'comm', 'cost', 'profile', 'so2_sweep',
                'v2_sweep', 'flash', 'fault', 'guard', 'fleet', 'quant_ab',
-               'trace', 'slo', 'summary')
+               'trace', 'slo', 'assembly', 'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
@@ -305,6 +305,15 @@ _REQUIRED = {
     'flash': ('run_id', 'label', 'fused_step_ms', 'unfused_step_ms',
               'fused_vs_unfused', 'hbm_unfused_vs_fused',
               'equivariance_l2_fused'),
+    # the large-assembly serving contract (kNN-free global attention):
+    # the memory ratio vs the materialized control arm, parity,
+    # equivariance, AND proof the request was actually served through
+    # an engine bucket with no post-warmup compile — an assembly record
+    # that cannot say all four proves nothing about O(n) serving
+    'assembly': ('run_id', 'label', 'n', 'bucket', 'global_peak_bytes',
+                 'materialized_peak_bytes', 'hbm_materialized_vs_global',
+                 'parity_linf', 'equivariance_l2', 'bucket_served',
+                 'post_warmup_compiles'),
     'summary': ('run_id', 'steps', 'metrics', 'timing'),
 }
 
@@ -674,6 +683,27 @@ def validate_record(rec: dict, index=None) -> dict:
                     or val < 0:
                 _fail(index, f'flash.{field} must be a non-negative '
                              f'number, got {val!r}')
+    if kind == 'assembly':
+        for field in ('n', 'bucket', 'post_warmup_compiles'):
+            val = rec[field]
+            if not isinstance(val, int) or isinstance(val, bool) \
+                    or val < 0:
+                _fail(index, f'assembly.{field} must be a non-negative '
+                             f'int, got {val!r}')
+        for field in ('global_peak_bytes', 'materialized_peak_bytes',
+                      'hbm_materialized_vs_global', 'parity_linf',
+                      'equivariance_l2'):
+            val = rec[field]
+            if not isinstance(val, (int, float)) or isinstance(val, bool) \
+                    or val < 0:
+                _fail(index, f'assembly.{field} must be a non-negative '
+                             f'number, got {val!r}')
+        if not isinstance(rec['bucket_served'], int) \
+                or isinstance(rec['bucket_served'], bool) \
+                or rec['bucket_served'] < 0:
+            _fail(index, f'assembly.bucket_served must be a non-negative '
+                         f'int (rows served through the engine bucket), '
+                         f'got {rec["bucket_served"]!r}')
     if kind == 'quant_ab':
         if not isinstance(rec['mix'], str) or not rec['mix']:
             _fail(index, f'quant_ab.mix must be a non-empty string, '

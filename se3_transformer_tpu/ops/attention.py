@@ -67,6 +67,20 @@ class AttentionSE3(nn.Module):
     # shared_radial_hidden; rotary/linear_proj_keys fall outside it.
     fuse_pairwise: bool = False
     flash_interpret: bool = False  # tests: interpreter-mode flash kernel
+    # attention_mode='global': the kNN-free large-assembly mode — no
+    # neighbor selection, no get_basis, no exchange_index_select; every
+    # node attends to every node with the rel_pos/radial/SH payload
+    # rebuilt per VMEM tile from coordinates (kernels.pallas_flash
+    # global mode, O(n) activation memory). Coordinates (+ node mask)
+    # ride in on the basis dict's reserved keys 'global_coords' /
+    # 'global_mask'. Under an active exchange scope (sequence_parallel=
+    # 'ring') the call routes to flash_global_attention_sharded: queries
+    # stay pinned, kv blocks rotate over the ring — only ppermutes, no
+    # full-width all-gather.
+    attention_mode: str = 'knn'
+    # the O(n^2)-memory control arm (assembly smoke / bench --assembly):
+    # identical params and math, per-edge tensors fully materialized
+    global_materialize: bool = False
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -74,6 +88,12 @@ class AttentionSE3(nn.Module):
                  global_feats: Optional[Features] = None,
                  pos_emb: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
                  mask: Optional[jnp.ndarray] = None) -> Features:
+        if self.attention_mode == 'global':
+            assert pos_emb is None, \
+                'global attention does not support rotary embeddings'
+            return self._global_call(features, basis, global_feats)
+        assert self.attention_mode == 'knn', \
+            f'unknown attention_mode {self.attention_mode!r}'
         if self.fuse_pairwise:
             return self._flash_call(features, edge_info, rel_dist, basis,
                                     global_feats, pos_emb)
@@ -326,32 +346,12 @@ class AttentionSE3(nn.Module):
             b, n = features[degree].shape[:2]
             q = queries[degree].reshape(b, n, h, Dh)
 
-            # prefix slots, left of the neighbors in the unfused concat
-            # order [global, null, self] — always valid (the unfused
-            # mask left-pads True over them)
-            pre_k, pre_v = [], []
-            if global_feats is not None and degree == '0':
-                g_k, g_v = global_keys['0'], global_values['0']
-                num_g = g_k.shape[1]
-                for t, dst in ((g_k, pre_k), (g_v, pre_v)):
-                    t = t.reshape(b, num_g, kv_h * Dh)[:, None]
-                    dst.append(jnp.broadcast_to(
-                        t, (b, n, num_g, kv_h * Dh)))
-            if self.use_null_kv:
-                null_k = self.param(f'null_k{degree}', nn.initializers.zeros,
-                                    (kv_h, self.dim_head, m), q.dtype)
-                null_v = self.param(f'null_v{degree}', nn.initializers.zeros,
-                                    (kv_h, self.dim_head, m), q.dtype)
-                for t, dst in ((null_k, pre_k), (null_v, pre_v)):
-                    dst.append(jnp.broadcast_to(
-                        t.reshape(1, 1, 1, kv_h * Dh),
-                        (b, n, 1, kv_h * Dh)))
-            if self.attend_self:
-                for t, dst in ((self_keys[degree], pre_k),
-                               (self_values[degree], pre_v)):
-                    dst.append(t.reshape(b, n, 1, kv_h * Dh))
-            prefix_k = jnp.concatenate(pre_k, axis=2) if pre_k else None
-            prefix_v = jnp.concatenate(pre_v, axis=2) if pre_v else None
+            prefix_k, prefix_v = self._prefix_slots(
+                degree, b, n, kv_h, Dh, q.dtype,
+                global_keys if global_feats is not None else None,
+                global_values if global_feats is not None else None,
+                self_keys if self.attend_self else None,
+                self_values if self.attend_self else None)
 
             xs = tuple(features[str(d_in)]
                        for d_in, _ in v_prog['pairs'])
@@ -376,6 +376,156 @@ class AttentionSE3(nn.Module):
                 pairs=v_prog['pairs'], d_out=int(degree), heads=h,
                 kv_heads=kv_h, scale=self.dim_head ** -0.5,
                 arm_v=v_prog['arm'], **kwargs)
+            outputs[degree] = out.reshape(b, n, h * self.dim_head, m)
+
+        if project_out:
+            outputs = LinearSE3(hidden_fiber, self.fiber,
+                                name='to_out')(outputs)
+        return outputs
+
+    def _prefix_slots(self, degree: str, b: int, n: int, kv_h: int,
+                      Dh: int, dtype, global_keys, global_values,
+                      self_keys, self_values):
+        """The always-valid kv slots left of the neighbor/pair axis, in
+        the unfused concat order [global, null, self] (the unfused mask
+        left-pads True over them). Shared by the kNN flash path and the
+        global path so the slot semantics — and the null_k/null_v param
+        names — cannot drift apart."""
+        m = to_order(int(degree))
+        pre_k, pre_v = [], []
+        if global_keys is not None and degree == '0':
+            g_k, g_v = global_keys['0'], global_values['0']
+            num_g = g_k.shape[1]
+            for t, dst in ((g_k, pre_k), (g_v, pre_v)):
+                t = t.reshape(b, num_g, kv_h * Dh)[:, None]
+                dst.append(jnp.broadcast_to(
+                    t, (b, n, num_g, kv_h * Dh)))
+        if self.use_null_kv:
+            null_k = self.param(f'null_k{degree}', nn.initializers.zeros,
+                                (kv_h, self.dim_head, m), dtype)
+            null_v = self.param(f'null_v{degree}', nn.initializers.zeros,
+                                (kv_h, self.dim_head, m), dtype)
+            for t, dst in ((null_k, pre_k), (null_v, pre_v)):
+                dst.append(jnp.broadcast_to(
+                    t.reshape(1, 1, 1, kv_h * Dh),
+                    (b, n, 1, kv_h * Dh)))
+        if self_keys is not None:
+            for t, dst in ((self_keys[degree], pre_k),
+                           (self_values[degree], pre_v)):
+                dst.append(t.reshape(b, n, 1, kv_h * Dh))
+        prefix_k = jnp.concatenate(pre_k, axis=2) if pre_k else None
+        prefix_v = jnp.concatenate(pre_v, axis=2) if pre_v else None
+        return prefix_k, prefix_v
+
+    def _global_call(self, features: Features,
+                     basis: Dict[str, jnp.ndarray],
+                     global_feats: Optional[Features]) -> Features:
+        """The kNN-free path (see the attention_mode field comment):
+        same parameters as the fused kNN path — LinearSE3 'to_q',
+        ConvSE3 'to_v'/'to_k' in global_radial program mode exporting
+        the radial trunk + grouped w3/b3 raw, the same prefix slots —
+        but no edge_info, no rel_dist, no basis tensors: the kernel
+        rebuilds the pair payload from coordinates per tile."""
+        from ..kernels.pallas_flash import (flash_global_attention,
+                                            flash_global_attention_sharded)
+        from ..parallel.exchange import active_exchange
+        from ..quant.qtensor import QuantTensor
+
+        h = self.heads
+        kv_h = self.kv_heads if self.kv_heads is not None else self.heads
+        assert not self.linear_proj_keys, \
+            'global attention needs conv keys (linear_proj_keys gathers ' \
+            'node-projected keys, which presumes a neighbor list)'
+        assert not self.fourier_encode_dist and not (self.edge_dim or 0), \
+            'global attention consumes raw distances only (no ' \
+            'fourier/edge features — the kernel rebuilds distances ' \
+            'from coordinates per tile)'
+        assert not self.conv_bf16, \
+            'global attention has no materialized conv operand to ' \
+            'store bf16'
+        coords = basis['global_coords']
+        node_mask = basis.get('global_mask')
+
+        hidden_fiber = self.fiber.to(self.dim_head * h)
+        kv_fiber = self.fiber.to(self.dim_head * kv_h)
+        project_out = not (h == 1 and len(self.fiber.dims) == 1
+                           and self.dim_head == self.fiber.dims[0])
+
+        conv_kwargs = dict(
+            pool=False, self_interaction=False,
+            shared_radial_hidden=True, fuse_pairwise=True,
+            global_radial=True, radial_bf16=self.radial_bf16)
+        no_edges = (None, None, None)
+
+        with named_scope('attn_qkv'):
+            queries = LinearSE3(self.fiber, hidden_fiber,
+                                name='to_q')(features)
+            v_prog = ConvSE3(self.fiber, kv_fiber, name='to_v',
+                             backend=self.backend_v, **conv_kwargs)(
+                features, no_edges, None, basis)
+            k_prog = None
+            if not self.tie_key_values:
+                k_prog = ConvSE3(self.fiber, kv_fiber, name='to_k',
+                                 backend=self.backend_k, **conv_kwargs)(
+                    features, no_edges, None, basis)
+            self_keys = self_values = None
+            if self.attend_self:
+                self_keys = LinearSE3(self.fiber, kv_fiber,
+                                      name='to_self_k')(features)
+                self_values = LinearSE3(self.fiber, kv_fiber,
+                                        name='to_self_v')(features)
+            global_keys = global_values = None
+            if global_feats is not None:
+                g_in = Fiber.create(1, self.global_feats_dim)
+                g_out = Fiber.create(1, self.dim_head * kv_h)
+                global_keys = LinearSE3(g_in, g_out,
+                                        name='to_global_k')(global_feats)
+                global_values = LinearSE3(g_in, g_out,
+                                          name='to_global_v')(global_feats)
+
+        def dq(w):
+            # the global kernel takes fp weights (no in-tile dequant
+            # epilogue on this path yet); a quantized checkpoint serves
+            # via a transient dequant
+            return w.dequant() if isinstance(w, QuantTensor) else w
+
+        ex = active_exchange()
+        outputs = {}
+        for degree in features.keys():
+            m = to_order(int(degree))
+            Dh = self.dim_head * m
+            b, n = features[degree].shape[:2]
+            q = queries[degree].reshape(b, n, h, Dh)
+
+            prefix_k, prefix_v = self._prefix_slots(
+                degree, b, n, kv_h, Dh, q.dtype,
+                global_keys, global_values, self_keys, self_values)
+
+            xs = tuple(features[str(d_in)] for d_in, _ in v_prog['pairs'])
+            kwargs = dict(
+                pairs=v_prog['pairs'], d_out=int(degree), heads=h,
+                kv_heads=kv_h, scale=self.dim_head ** -0.5,
+                arm=v_prog['arm'], node_mask=node_mask,
+                prefix_k=prefix_k, prefix_v=prefix_v,
+                exclude_self=True)
+            if k_prog is not None:
+                kwargs.update(rp_k=k_prog['rp'], wk=dq(k_prog['w3'][degree]),
+                              bk=k_prog['b3'][degree])
+            args = (q, xs, coords, v_prog['rp'], dq(v_prog['w3'][degree]),
+                    v_prog['b3'][degree])
+            if ex is not None and not self.global_materialize:
+                # sequence-parallel composition: the ring exchange scope
+                # is LIVE on this path (the PR 11 residue — the kNN
+                # flash gather bypassed it); queries stay pinned, the
+                # kv side rotates via ppermute only
+                out = flash_global_attention_sharded(
+                    *args, mesh=ex.mesh, axis_name=ex.axis_name,
+                    overlap=ex.overlap, **kwargs)
+            else:
+                out = flash_global_attention(
+                    *args, pallas=self.pallas,
+                    interpret=self.flash_interpret,
+                    materialize=self.global_materialize, **kwargs)
             outputs[degree] = out.reshape(b, n, h * self.dim_head, m)
 
         if project_out:
@@ -418,6 +568,8 @@ class AttentionBlockSE3(nn.Module):
     backend_k: str = 'dense'
     fuse_pairwise: bool = False
     flash_interpret: bool = False
+    attention_mode: str = 'knn'
+    global_materialize: bool = False
 
     @nn.compact
     def __call__(self, features: Features, edge_info: EdgeInfo,
@@ -452,6 +604,8 @@ class AttentionBlockSE3(nn.Module):
                 pallas_interpret=self.pallas_interpret,
                 fuse_pairwise=self.fuse_pairwise,
                 flash_interpret=self.flash_interpret,
+                attention_mode=self.attention_mode,
+                global_materialize=self.global_materialize,
                 name='attn')(out, edge_info, rel_dist, basis, global_feats,
                              pos_emb, mask)
         return residual_se3(out, res)

@@ -188,6 +188,41 @@ def radial_hidden(x: jnp.ndarray, mid_dim: int,
     return x
 
 
+class _DenseParams(nn.Module):
+    """Parameter source for one radial-trunk Dense layer: declares the
+    kernel/bias with names, shapes, and initializers IDENTICAL to
+    `_QuantDense` without running the matmul. The global (kNN-free)
+    attention mode uses this to export the raw radial weights to the
+    streaming kernel — there is no per-edge input to run the layer on —
+    while a `fuse_pairwise` checkpoint keeps loading unchanged."""
+    in_dim: int
+    features: int
+
+    @nn.compact
+    def __call__(self):
+        kernel = self.param('kernel', nn.initializers.lecun_normal(),
+                            (self.in_dim, self.features), jnp.float32)
+        bias = self.param('bias', nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        if isinstance(kernel, QuantTensor):
+            kernel = kernel.dequant()
+        return kernel, bias
+
+
+class _LayerNormParams(nn.Module):
+    """Parameter source mirroring `nn.LayerNorm` (scale ones, bias
+    zeros) — see `_DenseParams`."""
+    features: int
+
+    @nn.compact
+    def __call__(self):
+        scale = self.param('scale', nn.initializers.ones,
+                           (self.features,), jnp.float32)
+        bias = self.param('bias', nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        return scale, bias
+
+
 def _use_pallas(pallas: Optional[bool], interpret: bool) -> bool:
     """The one dispatch rule for the fused pairwise kernels: explicit
     setting wins, else auto on TPU (by device kind, not platform name —
@@ -695,6 +730,16 @@ class ConvSE3(nn.Module):
     # + the same radial trunk call order), so one checkpoint serves the
     # fused and unfused attention paths alike.
     fuse_pairwise: bool = False
+    # global_radial: the kNN-free escalation of fuse_pairwise — return
+    # the pairwise program with the radial trunk's RAW parameters
+    # (rp 8-tuple) instead of a precomputed per-edge hidden, because in
+    # global attention no per-edge tensor of ANY kind exists in HBM: the
+    # streaming kernel (kernels.pallas_flash global mode) rebuilds
+    # rel_pos/distance/radial/SH per VMEM tile from coordinates. Param
+    # names/shapes/initializers mirror radial_hidden's layers exactly
+    # (_DenseParams/_LayerNormParams + _grouped_pair_params), so one
+    # checkpoint serves the kNN-fused, unfused, and global paths alike.
+    global_radial: bool = False
 
     def _grouped_pair_params(self, degree_in: int, degree_out: int,
                              mid: int, m_in: int, m_out: int):
@@ -719,6 +764,43 @@ class ConvSE3(nn.Module):
                  rel_dist: jnp.ndarray, basis: Dict[str, jnp.ndarray]
                  ) -> Features:
         neighbor_indices, neighbor_masks, edges = edge_info
+
+        if self.global_radial:
+            # kNN-free pairwise-program mode (see the field comment).
+            # Branches before any rel_dist use: the caller passes
+            # rel_dist=None because distances are a per-tile kernel
+            # quantity here, not a model-level tensor.
+            assert self.shared_radial_hidden, \
+                'global_radial requires shared_radial_hidden=True (the ' \
+                'global kernel consumes the grouped w3/b3 layout)'
+            assert not self.pool and not self.self_interaction, \
+                'global_radial serves the attention kv path (pool=False)'
+            assert self.backend in ('dense', 'so2'), \
+                f'global_radial supports the dense/so2 arms, not ' \
+                f'{self.backend!r}'
+            assert not self.fourier_encode_dist and edges is None, \
+                'global attention consumes raw distances only (the ' \
+                'kernel rebuilds them from coordinates per tile; no ' \
+                'fourier/edge features)'
+            mid = DEFAULT_MID_DIM
+            w1, b1 = _DenseParams(1, mid, name='Dense_0')()
+            s1, o1 = _LayerNormParams(mid, name='LayerNorm_0')()
+            w2, b2 = _DenseParams(mid, mid, name='Dense_1')()
+            s2, o2 = _LayerNormParams(mid, name='LayerNorm_1')()
+            w3s: Dict[str, jnp.ndarray] = {}
+            b3s: Dict[str, jnp.ndarray] = {}
+            for degree_out, m_out in self.fiber_out:
+                ws, bs = [], []
+                for degree_in, m_in in self.fiber_in:
+                    w3, b3 = self._grouped_pair_params(
+                        degree_in, degree_out, mid, m_in, m_out)
+                    ws.append(w3)
+                    bs.append(b3)
+                w3s[str(degree_out)] = concat_weights(ws, axis=1)
+                b3s[str(degree_out)] = jnp.concatenate(bs, axis=0)
+            return dict(rp=(w1, b1, s1, o1, w2, b2, s2, o2),
+                        pairs=tuple((d, c) for d, c in self.fiber_in),
+                        arm=self.backend, w3=w3s, b3=b3s)
 
         rel_dist_feats = rel_dist[..., None]  # [b, n, k, 1]
         if self.fourier_encode_dist:

@@ -89,6 +89,10 @@ class SequentialTrunk(nn.Module):
     # block routes k/v + attention through kernels.pallas_flash.
     fused_attention: Optional[tuple] = None
     flash_interpret: bool = False
+    # 'global' = the kNN-free large-assembly mode (every block; see
+    # ops.attention.AttentionSE3.attention_mode)
+    attention_mode: str = 'knn'
+    global_materialize: bool = False
 
     @nn.compact
     def __call__(self, x: Features, edge_info, rel_dist, basis,
@@ -134,6 +138,8 @@ class SequentialTrunk(nn.Module):
                 fuse_pairwise=(self.fused_attention[i]
                                if self.fused_attention else False),
                 flash_interpret=self.flash_interpret,
+                attention_mode=self.attention_mode,
+                global_materialize=self.global_materialize,
                 name=f'attn_block{i}')(
                     x, edge_info, rel_dist, basis, global_feats, pos_emb,
                     mask)

@@ -1,0 +1,225 @@
+"""Coverage for the kNN-free global attention mode
+(`attention_mode='global'`: se3_transformer_tpu/models/se3_transformer
+`_global_forward` -> AttentionSE3._global_call ->
+kernels.pallas_flash.flash_global_attention).
+
+Load-bearing contracts (ISSUE 18 acceptance):
+  * the streaming global path computes the SAME function as the
+    `global_materialize=True` control arm (every [b, n, n, ...] pair
+    tensor in memory, plain autodiff) on IDENTICAL parameters — dense
+    and so2 arms, under a node mask, at an n NOT divisible by the
+    stream's chunk size (the ragged last chunk is where padding bugs
+    live);
+  * the custom_vjp backward (recompute-in-backward) produces the same
+    gradients as differentiating the materialized arm;
+  * equivariance holds through the global path at 1e-5 (tighter than
+    the repo-wide 1e-4 bar — no neighbor discretization to hide in);
+  * stream chunk counts resolve through the 'flash_global' tuning kind
+    and promoted table entries steer the dispatch;
+  * the sp=2 ring composition compiles ALL-GATHER-FREE (the PR 11
+    residue: the flash gather used to bypass the exchange scope);
+  * the oversize rejection carries the client-actionable `max_bucket`.
+
+Everything runs on CPU (conftest forces 8 virtual devices, so the
+sharded test builds a real 2-device mesh).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from se3_transformer_tpu.kernels import pallas_flash as pf
+from se3_transformer_tpu.kernels import tuning
+from se3_transformer_tpu.models.se3_transformer import SE3TransformerModule
+
+
+@pytest.fixture(autouse=True)
+def isolated_tuning(tmp_path, monkeypatch):
+    monkeypatch.setenv('SE3_TPU_CACHE_PATH', str(tmp_path))
+    monkeypatch.delenv('SE3_TPU_FLASH_BLOCKS', raising=False)
+    monkeypatch.delenv('SE3_TPU_FLASH_CHUNKS', raising=False)
+    tuning.reset_consults()
+    yield
+
+
+_KW = dict(num_tokens=24, dim=8, depth=1, num_degrees=2,
+           output_degrees=2, reduce_dim_out=True, attend_self=True,
+           use_null_kv=True, heads=2, dim_head=8, pallas=False,
+           attention_mode='global')
+
+
+def _inputs(n, seed=0, pad=5):
+    rng = np.random.RandomState(seed)
+    feats = jnp.asarray(rng.randint(0, 24, (1, n)))
+    coors = jnp.asarray(np.cumsum(rng.normal(size=(1, n, 3)), axis=1),
+                        jnp.float32)
+    mask = jnp.asarray(np.arange(n) < n - pad)[None]
+    return feats, coors, mask
+
+
+def _params(mod, feats, coors, mask):
+    return jax.jit(mod.init, static_argnames=('return_type',))(
+        jax.random.PRNGKey(0), feats, coors, mask=mask,
+        return_type=1)['params']
+
+
+@pytest.mark.parametrize('backend', ['dense', 'so2'])
+def test_global_matches_materialized_ragged_chunks(backend):
+    """n=37 with ~16-node chunks: 37 // 16 = 2 chunks of 19 and 18
+    rows — the stream's ragged split plus masked pad rows must still
+    reproduce the materialized arm bit-for-bit-ish on one param tree."""
+    n = 37
+    feats, coors, mask = _inputs(n)
+    stream = SE3TransformerModule(conv_backend=backend, **_KW)
+    ctrl = SE3TransformerModule(conv_backend=backend,
+                                global_materialize=True, **_KW)
+    params = _params(stream, feats, coors, mask)
+    # one checkpoint serves both arms: identical param trees
+    pc = _params(ctrl, feats, coors, mask)
+    assert jax.tree_util.tree_structure(params) == \
+        jax.tree_util.tree_structure(pc)
+    o1 = stream.apply({'params': params}, feats, coors, mask=mask,
+                      return_type=1)
+    o2 = ctrl.apply({'params': params}, feats, coors, mask=mask,
+                    return_type=1)
+    assert o1.shape == (1, n, 3)
+    assert float(jnp.abs(o1 - o2).max()) < 1e-5
+
+
+def test_global_grads_match_materialized():
+    """The streaming custom_vjp (recompute-in-backward) vs plain
+    autodiff through the materialized pair tensors, wrt params AND
+    coordinates."""
+    feats, coors, mask = _inputs(40)
+    stream = SE3TransformerModule(differentiable_coors=True, **_KW)
+    ctrl = SE3TransformerModule(differentiable_coors=True,
+                                global_materialize=True, **_KW)
+    params = _params(stream, feats, coors, mask)
+
+    def loss(mod):
+        def f(p, c):
+            out = mod.apply({'params': p}, feats, c, mask=mask,
+                            return_type=1)
+            return (out ** 2).sum()
+        return f
+
+    g1p, g1c = jax.grad(loss(stream), argnums=(0, 1))(params, coors)
+    g2p, g2c = jax.grad(loss(ctrl), argnums=(0, 1))(params, coors)
+    assert float(jnp.abs(g1c - g2c).max()) < 1e-4
+    flat1 = jax.tree_util.tree_leaves(g1p)
+    flat2 = jax.tree_util.tree_leaves(g2p)
+    assert len(flat1) == len(flat2)
+    for a, b in zip(flat1, flat2):
+        assert float(jnp.abs(a - b).max()) < 1e-4
+
+
+def test_global_equivariance():
+    from se3_transformer_tpu.utils.validation import equivariance_l2
+    feats, coors, mask = _inputs(29)
+    mod = SE3TransformerModule(**_KW)
+    params = _params(mod, feats, coors, mask)
+    assert equivariance_l2(mod, params, feats, coors, mask) < 1e-5
+
+
+def test_flash_global_tuning_kind_resolves_and_promotes():
+    # global shape key: K=0, prefix slots only (no neighbor axis)
+    shape = (4096, 0, 2, 2, 2, 24, 128, 32, 3, 256)
+    cands = tuning.admissible_candidates('flash_global', shape)
+    assert cands, 'no admissible flash_global candidates'
+    assert all(len(c) == 1 and shape[0] % 1 == 0 for c in cands)
+    assert all(c[0] <= shape[0] for c in cands)
+    # heuristic first, then a promoted table entry steers the stream
+    assert pf._pick_stream_chunks(shape, 'float32',
+                                  kind='flash_global') == 4096 // 16
+    tuning.promote('flash_global', shape, (64,))
+    assert pf._pick_stream_chunks(shape, 'float32',
+                                  kind='flash_global') == 64
+    adopted = tuning.consult_summary()['adopted']
+    assert {c['kernel'] for c in adopted} == {'flash_global'}
+    # the kNN stream kind is keyed separately: no cross-talk
+    assert pf._pick_stream_chunks(shape, 'float32',
+                                  kind='flash_stream') == 4096 // 16
+
+
+def test_global_sharded_ring_is_all_gather_free():
+    """sequence_parallel='ring' + global mode: partitioned HLO carries
+    ppermutes only — no full-width [b, n, ...] all-gather — and the
+    sharded output matches the unsharded stream."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from se3_transformer_tpu.parallel.exchange import analyze_hlo_comm
+
+    n = 32
+    feats, coors = _inputs(n, pad=0)[:2]
+    mask = jnp.ones((1, n), bool)
+    plain = SE3TransformerModule(**_KW)
+    params = _params(plain, feats, coors, mask)
+    ref = plain.apply({'params': params}, feats, coors, mask=mask,
+                      return_type=1)
+    mesh = Mesh(np.array(jax.devices()[:2]), ('sp',))
+    ring = SE3TransformerModule(sequence_parallel='ring', mesh=mesh,
+                                **_KW)
+
+    def fn(f, c, m):
+        return ring.apply({'params': params}, f, c, mask=m,
+                          return_type=1)
+
+    compiled = jax.jit(
+        fn, out_shardings=NamedSharding(mesh, P(None, 'sp')),
+    ).lower(feats, coors, mask).compile()
+    analysis = analyze_hlo_comm(compiled.as_text(), full_width_dim=n)
+    assert analysis['all_gather_free'], \
+        analysis['full_width_all_gathers']
+    assert analysis['collectives'].get('collective-permute'), \
+        'ring exchange should ppermute'
+    out = np.asarray(jax.device_get(compiled(feats, coors, mask)))
+    assert float(np.abs(out - np.asarray(ref)).max()) < 1e-5
+
+
+def test_global_mode_rejects_incompatible_config():
+    feats, coors, mask = _inputs(16)
+    bad = SE3TransformerModule(**{**_KW, 'fuse_pairwise': True})
+    with pytest.raises(AssertionError):
+        bad.init(jax.random.PRNGKey(0), feats, coors, mask=mask,
+                 return_type=1)
+
+
+def test_oversize_rejection_carries_max_bucket():
+    from se3_transformer_tpu.inference.admission import (
+        AdmissionController, RequestRejected, oversize_error,
+    )
+    err = oversize_error(30000, 4096)
+    assert err.detail['max_bucket'] == 4096
+    assert err.to_record()['max_bucket'] == 4096
+    ctl = AdmissionController(max_len=4096)
+    with pytest.raises(RequestRejected) as ei:
+        ctl.admit(length=30000)
+    assert ei.value.detail['max_bucket'] == 4096
+    assert ctl.snapshot()['rejected']['oversize'] == 1
+
+
+def test_assembly_record_schema_roundtrip(tmp_path):
+    from se3_transformer_tpu.observability.report import (
+        write_record_stream,
+    )
+    from se3_transformer_tpu.observability.schema import (
+        SchemaError, validate_record, validate_stream,
+    )
+    body = dict(kind='assembly', label='global_serving,n=4096',
+                n=4039, bucket=4096, global_peak_bytes=100,
+                materialized_peak_bytes=900,
+                hbm_materialized_vs_global=9.0, parity_linf=1e-8,
+                equivariance_l2=1e-8, bucket_served=1,
+                post_warmup_compiles=0)
+    path = tmp_path / 'assembly.jsonl'
+    write_record_stream(str(path), 'rid', [dict(body)])
+    info = validate_stream(str(path))
+    assert info['kinds']['assembly'] == 1
+    # the proof bits are typed: a float bucket_served or a negative
+    # compile count must not validate
+    for field, val in (('bucket_served', 1.5),
+                       ('post_warmup_compiles', -1),
+                       ('hbm_materialized_vs_global', -2.0)):
+        broken = dict(body, run_id='rid', **{field: val})
+        with pytest.raises(SchemaError):
+            validate_record(broken)

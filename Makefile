@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke serve-fleet-smoke slo-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke v2-smoke flash-smoke assembly-smoke chaos-smoke train-chaos-smoke quant-smoke perf-gate clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke serve-fleet-smoke slo-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke v2-smoke flash-smoke assembly-smoke mesh-smoke chaos-smoke train-chaos-smoke quant-smoke perf-gate clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -85,6 +85,13 @@ assembly-smoke:    ## kNN-free large-assembly serving gate (docs/PERFORMANCE.md 
 	python scripts/perf_gate.py /tmp/assembly_smoke.jsonl
 	rm -f /tmp/assembly_inject.jsonl
 	python scripts/assembly_smoke.py --metrics /tmp/assembly_inject.jsonl --inject-regression >/tmp/assembly_inject.log 2>&1; test $$? -eq 1 || { echo "assembly-smoke injected arm did NOT fire with rc=1 — a vanished memory win / broken equivariance / unserved bucket went undetected; output:"; cat /tmp/assembly_inject.log; exit 1; }  # rc=1 is the committed budgets FIRING on the corrupted record; any other rc (crash, argparse, rc=2 budgets-not-wired) fails loudly with the evidence
+
+mesh-smoke:        ## composed dp x sp x tp gate (docs/PERFORMANCE.md "Composed parallelism"): one composed (2,2,2) update matches dp-only (2,1,1) on the identical global problem to 1e-5, the flagship ring point compiles all-gather-free on the sequence axis WITH tp live (axis-aware HLO scan), the measured row banks as a schema'd mesh_sweep record (--require mesh_sweep) and the committed per-axis byte / memory / proof-bit budgets judge it; then the --inject-regression arm must exit rc==1, proving those budgets fire
+	rm -f /tmp/mesh_smoke.jsonl
+	python scripts/mesh_smoke.py --metrics /tmp/mesh_smoke.jsonl
+	python scripts/obs_report.py /tmp/mesh_smoke.jsonl --validate --require mesh_sweep --out /tmp/mesh_smoke_summary.json
+	rm -f /tmp/mesh_inject.jsonl
+	python scripts/mesh_smoke.py --metrics /tmp/mesh_inject.jsonl --inject-regression >/tmp/mesh_inject.log 2>&1; test $$? -eq 1 || { echo "mesh-smoke injected arm did NOT fire with rc=1 — a sequence-rematerializing all-gather / per-axis byte blowup / memory regression went undetected; output:"; cat /tmp/mesh_inject.log; exit 1; }  # rc=1 is the committed budgets FIRING on the corrupted record; any other rc (crash, argparse, rc=2 budgets-not-wired) fails loudly with the evidence
 
 chaos-smoke:       ## fault-domain gate (docs/ROBUSTNESS.md): seeded replica crashes + latency spikes + a torn latest checkpoint + one rolling swap over 3 CPU replicas — zero lost requests, >=1 observed quarantine->recovery, swap restores the FALLBACK step, schema'd fault records (--require fault), judged by the chaos perf budgets; then the WEAKENED arm (a fault class made droppable) must exit rc==1, proving the zero-lost gate fires
 	rm -f /tmp/chaos_smoke.jsonl

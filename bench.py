@@ -761,6 +761,72 @@ def ring_main(n_devices: int, per_device_nodes: int = None):
     return record
 
 
+def mesh_main(dp: int, sp: int, tp: int, per_device_nodes: int = None):
+    """`python bench.py --mesh dp,sp,tp`: composed-parallelism A/B on
+    the virtual CPU mesh (ROADMAP item 4). Arm A runs the dp x sp x tp
+    train step through the explicit-aliasing composed route
+    (scripts/width_table.py mesh_sweep_point — the same program the
+    MESH_SWEEP.jsonl bank rows come from); arm B runs the IDENTICAL
+    global problem (same batch, same node count) as plain (dp, 1, 1)
+    data parallelism. Placement is the only difference, so the ratio
+    isolates what composing sp and tp costs/buys on this host.
+
+    Prints ONE bench-shaped JSON line whose value is the composed arm's
+    nodes*steps/s; the dp-only control rides along
+    (`composed_vs_dp_only`) with BOTH arms' schema'd `comm` payloads —
+    per-class AND per-mesh-axis collective bytes plus the axis-aware
+    full-width all-gather scan — and both cost-ledger payloads. Same
+    CPU-mesh caveat as --ring: virtual devices share this host's cores,
+    so wall-clock ratios measure regression, not the ICI story; the
+    transferable wins are the all-gather-free proof bit and the
+    per-shard memory column. Never compared against the single-device
+    RECORD anchors: different program."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'scripts'))
+    import width_table
+
+    if per_device_nodes is None:
+        per_device_nodes = int(os.environ.get('SE3_TPU_MESH_PDN', 64))
+    n_devices = dp * sp * tp
+    jax = width_table._setup(max(n_devices, 2))
+    arms = {
+        'composed': width_table.mesh_sweep_point(
+            jax, dp, sp, tp, per_device_nodes, dim=16, k=8),
+        # same global shapes: b=dp, n=per_device_nodes*sp, on (dp,1,1)
+        'dp_only': width_table.mesh_sweep_point(
+            jax, dp, 1, 1, per_device_nodes * sp, dim=16, k=8),
+    }
+    composed = arms['composed']
+    n = composed['n']
+    assert arms['dp_only']['n'] == n, 'arms must share global shapes'
+    record = {
+        'metric': f'mesh_comm_ab_nodes_steps_per_sec'
+                  f'(dp={dp},sp={sp},tp={tp},pdn={per_device_nodes},'
+                  f'dim=16)',
+        'value': round(n / composed['step_s'], 2),
+        'unit': 'nodes*steps/sec/cpu-host',
+        'vs_baseline': 1.0,  # own-program A/B; RECORD anchors don't apply
+        'mode': 'mesh_ab',
+        'dp': dp, 'sp': sp, 'tp': tp,
+        'n': n,
+        'step_s': composed['step_s'],
+        'dp_only_step_s': arms['dp_only']['step_s'],
+        'composed_vs_dp_only': round(
+            arms['dp_only']['step_s'] / composed['step_s'], 3),
+        'per_shard_total_gb': composed.get('per_shard_total_gb'),
+        'dp_only_per_shard_total_gb':
+            arms['dp_only'].get('per_shard_total_gb'),
+        'comm': {arm: rec.get('comm') for arm, rec in arms.items()},
+        'cost': {arm: rec.get('cost') for arm, rec in arms.items()},
+        'loss_finite': bool(composed.get('loss_finite')
+                            and arms['dp_only'].get('loss_finite')),
+    }
+    if os.environ.get('SE3_TPU_CODE_REV'):
+        record['code_rev'] = os.environ['SE3_TPU_CODE_REV']
+    print(json.dumps(record))
+    return record
+
+
 def flash_main(steps: int = 6, n: int = 128, k: int = 16,
                num_degrees: int = 4, dim: int = 16):
     """`python bench.py --flash`: fused-vs-XLA streaming-attention A/B
@@ -1605,6 +1671,14 @@ if __name__ == '__main__':
         _i = sys.argv.index('--ring')
         _n = int(sys.argv[_i + 1]) if len(sys.argv) > _i + 1 else 8
         ring_main(_n)
+        sys.exit(0)
+    if '--mesh' in sys.argv[1:]:
+        # composed dp x sp x tp A/B on the virtual CPU mesh, same
+        # no-device-probe discipline as --ring
+        _i = sys.argv.index('--mesh')
+        _spec = sys.argv[_i + 1] if len(sys.argv) > _i + 1 else '2,2,2'
+        _dp, _sp, _tp = (int(x) for x in _spec.split(','))
+        mesh_main(_dp, _sp, _tp)
         sys.exit(0)
     _pipelined = '--pipelined' in sys.argv[1:]
     _backend, _reason = _device_backend_or_cpu()

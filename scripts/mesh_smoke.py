@@ -1,0 +1,220 @@
+"""CPU gate for composed dp x sp x tp parallelism (`make mesh-smoke`).
+
+The ROADMAP item 4 acceptance harness: the one-mesh composed train step
+must RUN (the jax-0.4.37 GSPMD donation bug killed the unpinned route),
+match plain data parallelism bit-for-bit-ish, stay all-gather-free on
+the sequence axis with tp live, and bank a schema'd `mesh_sweep` record
+the committed per-axis budgets judge.
+
+Four gates, exit non-zero on any failure:
+
+  1. PARITY — one composed (2,2,2) update vs the IDENTICAL global
+     problem as dp-only (2,1,1), same params, same pre-drawn noise
+     (in-step `jax.random` is sharding-dependent on this jax, so the
+     noise rides in the batch). Loss and every updated param leaf
+     <= 1e-5. This is the fast tier-1 sibling's check, re-proven at
+     smoke time.
+  2. ALL-GATHER-FREE — the flagship_fast composed (2,2,2) ring point
+     (scripts/width_table.py mesh_sweep_point) compiles with ZERO
+     sp-varying full-width all-gathers in its partitioned HLO
+     (parallel.exchange.analyze_hlo_comm with the axis-aware scan:
+     dp weight prefetches and tp channel gathers are placement
+     traffic; only sp-group gathers can rematerialize the sequence).
+  3. SCHEMA — the measured row validates as kind='mesh_sweep'
+     (observability.schema): per-axis collective split present, comm
+     mesh echoing the row's (dp, sp, tp), finite loss, executed
+     wall-clock.
+  4. BUDGETS — scripts/perf_gate.py judges the banked stream against
+     PERF_BUDGETS.json (per-axis byte ceilings at (2,2,2), the
+     every-point all-gather-free proof bit, the per-shard memory
+     ceiling).
+
+`--inject-regression` instead writes a schema-VALID but corrupted row
+(all_gather_free False with sp-group gather shapes, inflated per-axis
+bytes, per-shard memory over the ceiling) and requires `perf_gate.py`
+to FIRE on it, then exits 1 — proving the committed budgets actually
+bite (the Makefile asserts rc==1).
+
+    python scripts/mesh_smoke.py [--metrics MESH.jsonl] [--pdn 32]
+        [--inject-regression]
+"""
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
+
+PARITY_TOL = 1e-5
+
+
+def parity_gate(jax):
+    """One composed (2,2,2) update vs dp-only (2,1,1) on the identical
+    global problem: same init, same batch, same pre-drawn noise.
+    Returns the gate evidence dict; asserts loudly on breach."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from se3_transformer_tpu import SE3TransformerModule
+    from se3_transformer_tpu.parallel import make_mesh
+    from se3_transformer_tpu.parallel.sharding import (
+        composed_state_shardings, make_sharded_train_step,
+    )
+
+    module = SE3TransformerModule(dim=8, depth=1, attend_self=True,
+                                  num_neighbors=4, num_degrees=2,
+                                  output_degrees=2, heads=2, dim_head=4)
+    rng = np.random.RandomState(0)
+    b, n = 2, 16
+    feats = jnp.asarray(rng.normal(size=(b, n, 8)), jnp.float32)
+    coors = jnp.asarray(rng.normal(size=(b, n, 3)), jnp.float32)
+    mask = jnp.ones((b, n), bool)
+    params0 = jax.jit(module.init, static_argnames=('return_type',))(
+        jax.random.PRNGKey(0), feats, coors, mask=mask,
+        return_type=1)['params']
+    opt = optax.adam(1e-3)
+    noise0 = jax.random.normal(jax.random.PRNGKey(1), coors.shape)
+
+    def loss_fn(params, batch, key):
+        del key  # noise is data: in-jit rng is sharding-dependent here
+        noise = batch['noise']
+        out = module.apply({'params': params}, batch['feats'],
+                           batch['coors'] + noise, mask=batch['mask'],
+                           return_type=1)
+        return ((out - noise[:, :, None, :]) ** 2).mean(), {}
+
+    def run(mesh, composed):
+        # fresh buffers per arm: device_put onto a replicated spec can
+        # alias the source buffer, and the steps donate their state
+        params = jax.tree_util.tree_map(jnp.array, params0)
+        if composed:
+            params, opt_state, shardings = composed_state_shardings(
+                params, opt.init(params), mesh)
+            step = make_sharded_train_step(loss_fn, opt, mesh=mesh,
+                                           state_shardings=shardings)
+        else:
+            opt_state = jax.jit(opt.init)(params)
+            step = make_sharded_train_step(loss_fn, opt, mesh=mesh)
+        node = P('dp', 'sp', None) if composed else P('dp', None, None)
+        flat = P('dp', 'sp') if composed else P('dp', None)
+        batch = {
+            'feats': jax.device_put(feats, NamedSharding(mesh, node)),
+            'coors': jax.device_put(coors, NamedSharding(mesh, node)),
+            'noise': jax.device_put(noise0, NamedSharding(mesh, node)),
+            'mask': jax.device_put(mask, NamedSharding(mesh, flat)),
+        }
+        params, _, loss, _ = step(params, opt_state, batch,
+                                  jax.random.PRNGKey(2))
+        return float(loss), params
+
+    loss_c, params_c = run(make_mesh(dp=2, sp=2, tp=2), composed=True)
+    loss_d, params_d = run(make_mesh(jax.devices()[:2], dp=2, sp=1, tp=1),
+                           composed=False)
+    # pull to host first: the arms live on different meshes (8 vs 2
+    # devices) and jnp ops refuse cross-mesh operands
+    max_abs = max(
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(jax.tree_util.tree_leaves(params_c),
+                        jax.tree_util.tree_leaves(params_d)))
+    assert abs(loss_c - loss_d) <= PARITY_TOL * max(1.0, abs(loss_d)), \
+        f'PARITY breach: composed loss {loss_c} vs dp-only {loss_d}'
+    assert max_abs <= PARITY_TOL, \
+        f'PARITY breach: updated params diverge by {max_abs}'
+    n_tp = sum(
+        1 for leaf in jax.tree_util.tree_leaves(params_c)
+        if 'tp' in str(getattr(leaf.sharding, 'spec', '')))
+    assert n_tp >= 4, f'only {n_tp} params tp-sharded (cosmetic mesh?)'
+    return dict(parity_loss_composed=round(loss_c, 6),
+                parity_loss_dp_only=round(loss_d, 6),
+                parity_max_abs=float(f'{max_abs:.3g}'),
+                parity_tp_sharded_params=n_tp)
+
+
+def _corrupted_row(pdn):
+    """Schema-valid mesh_sweep row with every budgeted claim broken:
+    sp-group full-width gathers back, per-axis bytes inflated past the
+    committed ceilings, per-shard memory over the cap."""
+    big = dict(count=99, bytes=50_000_000)
+    return dict(
+        kind='mesh_sweep', dp=2, sp=2, tp=2, devices=8,
+        n=pdn * 2, per_device_nodes=pdn, step_s=1.0,
+        per_shard_total_gb=0.9, loss_finite=True,
+        injected=True,
+        comm=dict(
+            sp=2, ring_steps=2, overlap=True, exchange=True,
+            collectives={'all-gather': big, 'all-reduce': big,
+                         'collective-permute': big},
+            full_width_all_gathers=[f'f32[1,{pdn * 2},8,3]'] * 4,
+            all_gather_free=False,
+            axis_collectives={
+                'sp': {'collective-permute': big, 'all-reduce': big},
+                'dp': {'all-reduce': big},
+                'tp': {'all-reduce': big},
+            },
+            mesh=dict(dp=2, sp=2, tp=2),
+        ),
+    )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--metrics',
+                    default=os.path.join('/tmp', 'mesh_smoke.jsonl'))
+    ap.add_argument('--pdn', type=int, default=32,
+                    help='per-device nodes of the measured (2,2,2) row')
+    ap.add_argument('--inject-regression', action='store_true')
+    args = ap.parse_args(argv)
+
+    import width_table
+    jax = width_table._setup(8)
+
+    import perf_gate
+    from se3_transformer_tpu.observability.report import write_record_stream
+    from se3_transformer_tpu.observability.schema import validate_record
+
+    if args.inject_regression:
+        row = _corrupted_row(args.pdn)
+        validate_record(dict(row, run_id='inject'))
+        write_record_stream(args.metrics, f'mesh_inject_{os.getpid()}',
+                            [row])
+        rc = perf_gate.main([args.metrics])
+        if rc != 1:
+            print(f'mesh-smoke INJECTION NOT CAUGHT: perf_gate rc={rc} '
+                  f'on a corrupted row — the committed budgets are not '
+                  f'biting', file=sys.stderr)
+            sys.exit(2)
+        print('mesh-smoke injection: perf_gate FIRED as required')
+        sys.exit(1)
+
+    evidence = parity_gate(jax)
+    print(f'mesh-smoke parity ok: {json.dumps(evidence)}')
+
+    row = width_table.mesh_sweep_point(jax, 2, 2, 2, args.pdn,
+                                       dim=16, k=8, steps=2)
+    comm = row['comm']
+    assert comm['all_gather_free'], \
+        f'ALL-GATHER-FREE breach: {comm["full_width_all_gathers"]}'
+    assert row['loss_finite'], 'non-finite loss on the composed point'
+    assert comm.get('axis_collectives'), 'per-axis split missing'
+    row = dict(row, kind='mesh_sweep', **evidence)
+    validate_record(dict(row, run_id='pre'))   # fail BEFORE banking
+    write_record_stream(args.metrics, f'mesh_smoke_{os.getpid()}', [row])
+    print(f'mesh-smoke banked {args.metrics}: (2,2,2) pdn={args.pdn} '
+          f'step_s={row["step_s"]} per_shard_gb='
+          f'{row["per_shard_total_gb"]} all_gather_free=True')
+
+    rc = perf_gate.main([args.metrics])
+    if rc != 0:
+        print('mesh-smoke: committed budgets FAILED on the fresh row',
+              file=sys.stderr)
+        sys.exit(rc)
+    print('mesh-smoke ok: parity + all-gather-free + schema + budgets')
+
+
+if __name__ == '__main__':
+    main()

@@ -6,8 +6,9 @@ Usage:
         [--code-rev REV] [--require kind[,kind...]]
 
 --require gates the stream on record kinds (pipeline / comm / tune /
-cost / profile / serve), each with its load-bearing check; the old
---require-pipeline/--require-comm/--require-tune flags are aliases.
+cost / profile / serve / ... / assembly / mesh_sweep), each with its
+load-bearing check; the old --require-pipeline/--require-comm/
+--require-tune flags are aliases.
 
 Input species are auto-detected per record:
   * bench records ({"metric", "value", "unit", ...} — BENCH_SESSION.jsonl,
@@ -508,6 +509,43 @@ def _gate_assembly(records):
     return True
 
 
+def _gate_mesh_sweep(records):
+    recs = [r for r in records if r.get('kind') == 'mesh_sweep']
+    if not recs:
+        print('MESH GATE: no mesh_sweep records in the stream (was '
+              'scripts/width_table.py --mesh-sweep run?)', file=sys.stderr)
+        return False
+    # latest row per (dp, sp, tp) point: EVERY mesh point must hold the
+    # composed contract, not just the final one swept
+    latest = {}
+    for r in recs:
+        latest[(r.get('dp'), r.get('sp'), r.get('tp'))] = r
+    bad = []
+    for point, r in sorted(latest.items()):
+        comm = r.get('comm') or {}
+        if not r.get('loss_finite'):
+            bad.append(f'{point}: non-finite loss')
+        elif not comm.get('all_gather_free'):
+            bad.append(f'{point}: full-width all-gathers '
+                       f'{comm.get("full_width_all_gathers")}')
+        elif not comm.get('axis_collectives', {}) and (
+                r.get('sp', 1) > 1 or r.get('dp', 1) > 1
+                or r.get('tp', 1) > 1):
+            bad.append(f'{point}: empty axis_collectives on a '
+                       f'multi-axis mesh — nothing to gate per axis')
+    if bad:
+        print(f'MESH GATE: {len(bad)}/{len(latest)} mesh points '
+              f'breach the composed contract: ' + '; '.join(bad),
+              file=sys.stderr)
+        return False
+    pts = ' '.join(f'({d},{s},{t})' for d, s, t in sorted(latest))
+    print(f'mesh gate ok: {len(recs)} mesh_sweep records over '
+          f'{len(latest)} points {pts} — all loss-finite and '
+          f'all-gather-free with per-axis attribution (byte ceilings '
+          f'are enforced by scripts/perf_gate.py)', file=sys.stderr)
+    return True
+
+
 _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       tune=_gate_tune, cost=_gate_cost,
                       profile=_gate_profile, serve=_gate_serve,
@@ -516,7 +554,8 @@ _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       fault=_gate_fault, guard=_gate_guard,
                       fleet=_gate_fleet, quant_ab=_gate_quant_ab,
                       trace=_gate_trace, slo=_gate_slo,
-                      assembly=_gate_assembly)
+                      assembly=_gate_assembly,
+                      mesh_sweep=_gate_mesh_sweep)
 
 
 def main(argv=None):

@@ -35,10 +35,11 @@ Budget semantics (see PERF_BUDGETS.json):
   * evaluation uses the LAST matching record — streams are
     append-only chronological, so the latest evidence is gated and
     historical rows can never permanently trip a tightened budget.
-    `group_by` (dotted path, e.g. "sp") instead judges the latest
-    record of EVERY distinct value of that field, so a proof bit over
-    a sweep ("all_gather_free at every sp") cannot be masked by the
-    final sweep point being clean.
+    `group_by` (dotted path, e.g. "sp", or a comma-separated list of
+    paths, e.g. "dp,sp,tp") instead judges the latest record of EVERY
+    distinct value (tuple of values) of those fields, so a proof bit
+    over a sweep ("all_gather_free at every sp" / "at every mesh
+    point") cannot be masked by the final sweep point being clean.
   * `axis`   — annotation naming the mesh axis a collective budget
     guards (surfaced in the diff, so an sp-axis regression reads as
     one).
@@ -86,12 +87,18 @@ DEFAULT_BUDGETS = os.path.join(REPO, 'PERF_BUDGETS.json')
 # large-assembly stream, so the >=3x streaming-vs-materialized peak-HBM
 # floor at the 4096 bucket, the tightened global equivariance ceiling,
 # and the served-through-an-engine-bucket proof bit are judged too.
+# MESH_SWEEP.jsonl: the banked `make mesh-smoke` composed-parallelism
+# sweep (one row per (dp,sp,tp) mesh point on the 8-device sim), so the
+# every-point all-gather-free proof bit, the per-axis ppermute /
+# all-reduce byte ceilings, and the per-shard memory ceiling are judged
+# by a plain `make perf-gate`.
 DEFAULT_RECORDS = ('BENCH_r05.json', 'WIDTH_TABLE.jsonl',
                    'SERVE_MULTI.jsonl', 'SO2_SWEEP.jsonl',
                    'FLASH_AB.jsonl', 'CHAOS_SMOKE.jsonl',
                    'QUANT_AB.jsonl', 'TRAIN_CHAOS.jsonl',
                    'FLEET_CHAOS.jsonl', 'SLO_SMOKE.jsonl',
-                   'V2_SWEEP.jsonl', 'ASSEMBLY_SWEEP.jsonl')
+                   'V2_SWEEP.jsonl', 'ASSEMBLY_SWEEP.jsonl',
+                   'MESH_SWEEP.jsonl')
 
 
 # --------------------------------------------------------------------- #
@@ -153,28 +160,34 @@ def matches(rec, match):
 def evaluate(budget, records):
     """-> (status, detail) with status in {'ok', 'FAIL', 'skip'}.
 
-    With `group_by` (a dotted path, e.g. "sp"), the pool is partitioned
-    by that field's value and the LAST record of EVERY group is judged
-    — a proof-bit budget over a multi-point sweep (all_gather_free "at
-    every sp") can then never be masked by the final sweep point being
-    clean while an earlier one regressed."""
+    With `group_by` (a dotted path, e.g. "sp", or several separated by
+    commas, e.g. "dp,sp,tp"), the pool is partitioned by those fields'
+    value tuple and the LAST record of EVERY group is judged — a
+    proof-bit budget over a multi-point sweep (all_gather_free "at
+    every sp" / "at every (dp,sp,tp) mesh point") can then never be
+    masked by the final sweep point being clean while an earlier one
+    regressed. Multi-key grouping matters on composed sweeps: grouped
+    by "sp" alone, a clean (2,2,2) row would shadow a regressed
+    (4,2,1) row that shares its sp value."""
     group_by = budget.get('group_by')
     if group_by:
         pool = [r for r in records if record_kind(r) == budget.get('kind')
                 and matches(r, budget.get('match'))]
         if not pool:
             return 'skip', f'no matching {budget.get("kind")} record'
+        keys = [k.strip() for k in group_by.split(',') if k.strip()]
         groups = {}
         for r in pool:   # later records overwrite: latest-per-group
-            groups[str(get_path(r, group_by))] = r
-        results = [_evaluate_one(budget, [rec])
-                   for _, rec in sorted(groups.items())]
-        fails = [d for s, d in results if s == 'FAIL']
+            groups[tuple(str(get_path(r, k)) for k in keys)] = r
+        results = [(key, *_evaluate_one(budget, [rec]))
+                   for key, rec in sorted(groups.items())]
+        fails = [f'{key[0] if len(key) == 1 else key}: {d}'
+                 for key, s, d in results if s == 'FAIL']
         if fails:
             return 'FAIL', f'{len(fails)}/{len(results)} {group_by}-' \
                            f'groups breach: ' + '; '.join(fails)
         return 'ok', f'all {len(results)} {group_by}-groups ok ' \
-                     f'(latest per group judged; e.g. {results[0][1]})'
+                     f'(latest per group judged; e.g. {results[0][2]})'
     return _evaluate_one(budget, records)
 
 

@@ -23,10 +23,18 @@ fixed at backend init):
     python scripts/width_table.py --devices 1 --dims 64 128
     python scripts/width_table.py --devices 8 --weak-scaling --ab \
         [--metrics COMM.jsonl]
+    python scripts/width_table.py --devices 8 --mesh-sweep \
+        [--points 2,2,2 4,1,2]
 Writes crash-safe JSONL to WIDTH_TABLE.jsonl (append). --weak-scaling
 rows carry a `comm` payload (collective classes/bytes + the full-width
 all-gather scan of the traced HLO); --ab measures the overlapped+sparse
 vs serialized+dense comm arms in one process (docs/PERF.md's table).
+--mesh-sweep instead walks every (dp, sp, tp) mesh point covering the
+device count through the composed-parallelism route (params+opt state
+over (dp, tp), ring sp when sp>1, donation pinned through explicit
+in/out shardings) and banks schema'd `mesh_sweep` records — per-axis
+collective split + per-shard memory — to MESH_SWEEP.jsonl for
+scripts/perf_gate.py's per-axis budgets.
 """
 import argparse
 import json
@@ -257,6 +265,112 @@ def weak_scaling_point(jax, n_devices, per_device_nodes, dim, k, steps=3,
     return rec
 
 
+def mesh_sweep_point(jax, dp, sp, tp, per_device_nodes, dim, k, steps=3):
+    """One composed-parallelism row (ROADMAP item 4): the dp x sp x tp
+    train step at FIXED per-device work (batch dp, nodes
+    per_device_nodes * sp), built through the explicit-aliasing route
+    (parallel.sharding.composed_state_shardings: params + opt state
+    over (dp, tp), step in/out shardings pinned, donation ON — the
+    exact configuration the jax-0.4.37 GSPMD donation bug kills
+    without the pin) and EXECUTED for wall-clock. The row's `comm`
+    block carries the per-mesh-axis collective split
+    (parallel.exchange.attribute_collective_axes) the per-axis budgets
+    in PERF_BUDGETS.json gate on, plus the all-gather-free proof scan;
+    `cost` is the usual ledger, and per_shard_total_gb the XLA
+    per-shard memory estimate."""
+    import time as _time
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from se3_transformer_tpu.parallel.exchange import comm_payload
+    from se3_transformer_tpu.parallel.mesh import make_mesh, mesh_shape_dict
+    from se3_transformer_tpu.parallel.sharding import (
+        composed_state_shardings, make_sharded_train_step,
+    )
+    from se3_transformer_tpu.training import recipes
+
+    n_devices = dp * sp * tp
+    b, n = dp, per_device_nodes * sp
+    mesh = make_mesh(jax.devices()[:n_devices], dp=dp, sp=sp, tp=tp)
+    ring = dict(sequence_parallel='ring', ring_overlap=True,
+                ring_exchange=True) if sp > 1 else {}
+    module = recipes.RECIPES['flagship_fast'](
+        dim=dim, num_neighbors=k, output_degrees=2, reduce_dim_out=True,
+        depth=1, mesh=mesh, **ring)
+
+    rng = np.random.RandomState(0)
+    node_spec = P('dp', 'sp', None)
+    feats = jax.device_put(
+        jnp.asarray(rng.normal(size=(b, n, dim)), jnp.float32),
+        NamedSharding(mesh, node_spec))
+    coords = jax.device_put(
+        jnp.asarray(np.cumsum(rng.normal(size=(b, n, 3)), axis=1),
+                    jnp.float32), NamedSharding(mesh, node_spec))
+    masks = jax.device_put(jnp.ones((b, n), bool),
+                           NamedSharding(mesh, P('dp', 'sp')))
+
+    def loss_fn(params, data, key):
+        noise = jax.random.normal(key, data['coords'].shape,
+                                  data['coords'].dtype)
+        noised = data['coords'] + noise
+        out = module.apply({'params': params}, data['seqs'], noised,
+                           mask=data['masks'], return_type=1)
+        return (((noised + out) - data['coords']) ** 2).sum(-1).mean(), {}
+
+    params = jax.jit(module.init, static_argnames=('return_type',))(
+        jax.random.PRNGKey(0), feats, coords, mask=masks,
+        return_type=1)['params']
+    optimizer = optax.adam(1e-4)
+    params, opt_state, shardings = composed_state_shardings(
+        params, optimizer.init(params), mesh)
+    step = make_sharded_train_step(loss_fn, optimizer, mesh=mesh,
+                                   state_shardings=shardings)
+    data = dict(seqs=feats, coords=coords, masks=masks)
+    key = jax.random.PRNGKey(1)
+
+    t0 = _time.time()
+    compiled = step.lower(params, opt_state, data, key).compile()
+    compile_s = _time.time() - t0
+    rec = dict(dp=dp, sp=sp, tp=tp, devices=n_devices, b=b, n=n,
+               per_device_nodes=per_device_nodes, dim=dim, k=k, depth=1,
+               compile_s=round(compile_s, 1), host_cpus=os.cpu_count(),
+               backend='cpu-spmd')
+    hlo_text = compiled.as_text()
+    rec['comm'] = comm_payload(
+        hlo_text, sp=sp, ring_steps=sp, overlap=sp > 1, exchange=sp > 1,
+        full_width_dim=n, mesh_shape=mesh_shape_dict(mesh))
+    try:
+        from se3_transformer_tpu.observability.costs import cost_payload
+        rec['cost'] = cost_payload(
+            compiled, hlo_text=hlo_text,
+            label=f'mesh_sweep,dp={dp},sp={sp},tp={tp},'
+                  f'pdn={per_device_nodes}')
+        mem = rec['cost']['memory']
+        rec['per_shard_total_gb'] = round(
+            (mem['temp_bytes'] + mem['argument_bytes']) / 2**30, 3)
+    except Exception as e:  # noqa: BLE001 - memory analysis best-effort
+        rec['memory_analysis_error'] = f'{type(e).__name__}: {e}'[:200]
+        rec['per_shard_total_gb'] = 0.0   # schema'd field; error above
+        #                                   flags the degenerate value
+    # donation is ON (the aliasing route under test) — rebind the
+    # donated state every call or the second step reads invalidated
+    # buffers
+    params, opt_state, loss, _ = compiled(params, opt_state, data, key)
+    jax.block_until_ready(loss)                               # warmup
+    t0 = _time.time()
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        params, opt_state, loss, _ = compiled(params, opt_state, data,
+                                              sub)
+    jax.block_until_ready(loss)
+    rec['step_s'] = round((_time.time() - t0) / steps, 3)
+    rec['loss_finite'] = bool(jax.numpy.isfinite(loss))
+    return rec
+
+
 def _write_comm_stream(path, recs):
     """Schema-valid telemetry stream for the weak-scaling run: run_meta +
     one `comm` AND one `cost` record per measured arm (observability
@@ -297,6 +411,17 @@ def main(argv=None):
                     help='one weak-scaling row: sp=devices ring path at '
                          'fixed per-device nodes, executed (fresh process '
                          'per device count)')
+    ap.add_argument('--mesh-sweep', action='store_true',
+                    help='composed dp x sp x tp sweep: every (dp,sp,tp) '
+                         'mesh point covering --devices (mesh.mesh_points)'
+                         ', each built via the explicit-aliasing route '
+                         'and executed; writes a schema-valid mesh_sweep '
+                         'stream (default MESH_SWEEP.jsonl, append)')
+    ap.add_argument('--points', nargs='*', default=None,
+                    metavar='DP,SP,TP',
+                    help='with --mesh-sweep: explicit mesh points '
+                         '(e.g. 2,2,2 4,1,2) instead of the full '
+                         'enumeration')
     ap.add_argument('--per-device-nodes', type=int, default=256)
     ap.add_argument('--weak-dim', type=int, default=16)
     ap.add_argument('--ab', action='store_true',
@@ -320,6 +445,37 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     jax = _setup(args.devices)
+
+    if args.mesh_sweep:
+        from se3_transformer_tpu.observability.report import (
+            write_record_stream,
+        )
+        from se3_transformer_tpu.parallel.mesh import mesh_points
+        if args.points:
+            points = [tuple(int(x) for x in p.split(','))
+                      for p in args.points]
+            bad = [p for p in points
+                   if len(p) != 3 or
+                   p[0] * p[1] * p[2] != args.devices]
+            assert not bad, \
+                f'points {bad} do not cover {args.devices} devices'
+        else:
+            points = mesh_points(args.devices)
+        out = args.out
+        if os.path.basename(out) == 'WIDTH_TABLE.jsonl':
+            out = os.path.join(os.path.dirname(out), 'MESH_SWEEP.jsonl')
+        bodies = []
+        for dp, sp, tp in points:
+            rec = mesh_sweep_point(jax, dp, sp, tp,
+                                   args.per_device_nodes, args.weak_dim,
+                                   min(args.k, 8))
+            print(json.dumps(rec), flush=True)
+            bodies.append(dict(rec, kind='mesh_sweep'))
+        write_record_stream(out, f'mesh_sweep_{os.getpid()}', bodies,
+                            append=True)
+        print(f'{len(bodies)} mesh_sweep records -> {out}',
+              file=sys.stderr)
+        return
 
     if args.weak_scaling:
         arms = [(True, True), (False, False)] if args.ab else \

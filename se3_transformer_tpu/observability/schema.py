@@ -192,6 +192,18 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    burn_rate}), breaker_dwell (per-host seconds in
                    each breaker state off the transition log), and the
                    rollout/rollback history.
+  mesh_sweep       composed dp x sp x tp parallelism evidence for ONE
+                   mesh point (scripts/width_table.py --mesh-sweep,
+                   banked to MESH_SWEEP.jsonl by `make mesh-smoke`):
+                   dp/sp/tp axis sizes, n / per_device_nodes, executed
+                   step_s + loss_finite, per_shard_total_gb (XLA
+                   per-shard memory), and the load-bearing comm block
+                   (parallel.exchange.comm_payload WITH mesh_shape):
+                   collectives, all_gather_free, and axis_collectives
+                   — the per-mesh-axis {count, bytes} split that
+                   PERF_BUDGETS.json's per-axis ceilings gate on. A
+                   sweep row that cannot attribute its traffic to an
+                   axis proves nothing about which axis regressed.
   summary          end-of-run cumulative record (metrics, timing,
                    nodes_steps_per_sec, loss trajectory,
                    retrace_warnings_total).
@@ -210,7 +222,7 @@ SCHEMA_VERSION = 1
 KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
                'serve', 'tune', 'comm', 'cost', 'profile', 'so2_sweep',
                'v2_sweep', 'flash', 'fault', 'guard', 'fleet', 'quant_ab',
-               'trace', 'slo', 'assembly', 'summary')
+               'trace', 'slo', 'assembly', 'mesh_sweep', 'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
@@ -314,6 +326,12 @@ _REQUIRED = {
                  'materialized_peak_bytes', 'hbm_materialized_vs_global',
                  'parity_linf', 'equivariance_l2', 'bucket_served',
                  'post_warmup_compiles'),
+    # axis_collectives (inside comm) is the load-bearing field of the
+    # composed-parallelism contract: a mesh-point row that cannot split
+    # its collective traffic by mesh axis cannot be gated per axis, so
+    # a tp regression would hide inside the dp gradient psum
+    'mesh_sweep': ('run_id', 'dp', 'sp', 'tp', 'n', 'per_device_nodes',
+                   'step_s', 'per_shard_total_gb', 'loss_finite', 'comm'),
     'summary': ('run_id', 'steps', 'metrics', 'timing'),
 }
 
@@ -704,6 +722,59 @@ def validate_record(rec: dict, index=None) -> dict:
             _fail(index, f'assembly.bucket_served must be a non-negative '
                          f'int (rows served through the engine bucket), '
                          f'got {rec["bucket_served"]!r}')
+    if kind == 'mesh_sweep':
+        for field in ('dp', 'sp', 'tp', 'n', 'per_device_nodes'):
+            if not isinstance(rec[field], int) \
+                    or isinstance(rec[field], bool) or rec[field] < 1:
+                _fail(index, f'mesh_sweep.{field} must be a positive '
+                             f'int, got {rec[field]!r}')
+        for field in ('step_s', 'per_shard_total_gb'):
+            val = rec[field]
+            if not isinstance(val, (int, float)) or isinstance(val, bool) \
+                    or val < 0:
+                _fail(index, f'mesh_sweep.{field} must be a non-negative '
+                             f'number, got {val!r}')
+        if not isinstance(rec['loss_finite'], bool):
+            _fail(index, f'mesh_sweep.loss_finite must be a bool, got '
+                         f'{rec["loss_finite"]!r}')
+        comm = rec['comm']
+        if not isinstance(comm, dict):
+            _fail(index, 'mesh_sweep.comm must be an object (the '
+                         'comm_payload block)')
+        for field in ('collectives', 'all_gather_free',
+                      'axis_collectives', 'mesh'):
+            if field not in comm:
+                _fail(index, f'mesh_sweep.comm missing {field!r} — the '
+                             f'per-axis split is the point of the record')
+        if not isinstance(comm['all_gather_free'], bool):
+            _fail(index, f'mesh_sweep.comm.all_gather_free must be a '
+                         f'bool, got {comm["all_gather_free"]!r}')
+        mesh_shape = comm['mesh']
+        if not isinstance(mesh_shape, dict) or any(
+                mesh_shape.get(a) != rec[a] for a in ('dp', 'sp', 'tp')):
+            _fail(index, f'mesh_sweep.comm.mesh {mesh_shape!r} must echo '
+                         f'the row axes dp={rec["dp"]} sp={rec["sp"]} '
+                         f'tp={rec["tp"]} (the attribution ran on a '
+                         f'different mesh otherwise)')
+        axes = comm['axis_collectives']
+        if not isinstance(axes, dict):
+            _fail(index, 'mesh_sweep.comm.axis_collectives must be an '
+                         'object (per-axis-label per-class accounting)')
+        known = set(mesh_shape) | {'local'}
+        for label, classes in axes.items():
+            parts = set(label.split('+'))
+            if not parts <= known:
+                _fail(index, f'axis_collectives label {label!r} names '
+                             f'non-mesh axes {sorted(parts - known)}')
+            if not isinstance(classes, dict):
+                _fail(index, f'axis_collectives[{label!r}] must be an '
+                             f'object')
+            for cls, st in classes.items():
+                missing = [k for k in ('count', 'bytes')
+                           if not isinstance(st, dict) or k not in st]
+                if missing:
+                    _fail(index, f'axis_collectives[{label!r}][{cls!r}] '
+                                 f'missing {missing}')
     if kind == 'quant_ab':
         if not isinstance(rec['mix'], str) or not rec['mix']:
             _fail(index, f'quant_ab.mix must be a non-empty string, '

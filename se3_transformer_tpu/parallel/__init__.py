@@ -1,16 +1,20 @@
-from .mesh import make_mesh, shard_batch, data_specs, MESH_AXES
+from .mesh import (
+    make_mesh, mesh_points, mesh_shape_dict, shard_batch, data_specs,
+    MESH_AXES,
+)
 from . import distributed
 from .ring import ring_knn, dense_knn
 from .exchange import (
-    analyze_hlo_comm, bonded_priority_mask, comm_payload,
-    exchange_index_select, exchange_scope, neighbor_gather, rowwise_gather,
+    analyze_hlo_comm, attribute_collective_axes, bonded_priority_mask,
+    comm_payload, exchange_index_select, exchange_scope, neighbor_gather,
+    rowwise_gather,
 )
 from .rules import (
-    RULE_SETS, fsdp_rules, match_partition_rules,
+    RULE_SETS, composed_rules, fsdp_rules, match_partition_rules,
     opt_state_partition_specs, place_with_rules,
     replicated_rules, resolve_rules, shard_opt_state, tp_rules,
 )
 from .sharding import (
     make_sharded_train_step, make_accumulating_train_step, replicated,
-    param_partition_specs, shard_params,
+    composed_state_shardings, param_partition_specs, shard_params,
 )

@@ -48,6 +48,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..utils.helpers import batched_index_select
@@ -263,7 +264,9 @@ _GATHER_DIM_RE = re.compile(r'dimensions=\{(\d+)\}')
 
 
 def analyze_hlo_comm(hlo_text: str,
-                     full_width_dim: Optional[int] = None) -> dict:
+                     full_width_dim: Optional[int] = None,
+                     mesh_shape: Optional[dict] = None,
+                     seq_axis: str = 'sp') -> dict:
     """Parse compiled (post-partitioning) HLO text into per-class
     collective counts and estimated byte volumes.
 
@@ -278,7 +281,39 @@ def analyze_hlo_comm(hlo_text: str,
     each op's transferred result, per execution of the op's computation
     (loop trip counts are invisible in HLO text — stated as per-class
     *shape* bytes, not per-step traffic).
+
+    mesh_shape (ordered {axis: size}, see `attribute_collective_axes`):
+    makes the full-width scan AXIS-AWARE for composed meshes. The node
+    axis is sharded over `seq_axis` only, so a >= N output dimension
+    can only be materialized by gathering across the seq-axis device
+    groups — an all-gather whose replica groups hold the seq coordinate
+    fixed (a dp weight prefetch, a tp channel gather) cannot
+    rematerialize the sequence even when an unrelated channel dim
+    happens to reach N (heads*dim_head collides with toy node counts).
+    A flagged line with no group attribute spans every device and stays
+    counted; with seq_axis at size 1 nothing shards the sequence and no
+    grouped gather is flagged.
     """
+    seq_varies = None
+    if mesh_shape is not None:
+        axis_names = list(mesh_shape)
+        sizes = [int(mesh_shape[a]) for a in axis_names]
+        seq_idx = axis_names.index(seq_axis) if seq_axis in axis_names \
+            else None
+
+        def seq_varies(line):
+            groups = _collective_groups(line)
+            if groups is None:
+                return True  # spans every device, incl. the seq axis
+            if seq_idx is None:
+                return False
+            for grp in groups:
+                base = _device_coords(grp[0], sizes)[seq_idx]
+                for member in grp[1:]:
+                    if _device_coords(member, sizes)[seq_idx] != base:
+                        return True
+            return False
+
     classes: dict = {}
     full_width_hits = []
     for line in hlo_text.splitlines():
@@ -310,6 +345,8 @@ def analyze_hlo_comm(hlo_text: str,
                     and dims[axis] >= full_width_dim
             else:  # no dimensions attribute — conservative any-dim scan
                 full = any(d >= full_width_dim for d in dims[1:])
+            if full and seq_varies is not None and not seq_varies(line):
+                full = False
             if full:
                 full_width_hits.append(f'{dtype}[{dims_s}]')
     return dict(
@@ -319,14 +356,122 @@ def analyze_hlo_comm(hlo_text: str,
     )
 
 
+# per-axis attribution: map each collective's replica groups back onto
+# mesh axes. Post-SPMD HLO names groups either explicitly
+# (replica_groups={{0,1},{2,3}}), in the iota form
+# (replica_groups=[4,2]<=[8] or [4,2]<=[2,4]T(1,0)), or — for
+# collective-permute — as source_target_pairs={{0,2},{2,0}}.
+_EXPLICIT_GROUPS_RE = re.compile(
+    r'replica_groups=\{(\{[^{}]*\}(?:,\{[^{}]*\})*)\}')
+_IOTA_GROUPS_RE = re.compile(
+    r'replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\]'
+    r'(?:T\(([\d,]+)\))?')
+_PAIRS_RE = re.compile(
+    r'source_target_pairs=\{(\{[^{}]*\}(?:,\{[^{}]*\})*)\}')
+
+
+def _collective_groups(line: str) -> Optional[list]:
+    """Device-id groups of one HLO collective line (each a list of
+    ints), or None when the line carries no group attribute."""
+    m = _EXPLICIT_GROUPS_RE.search(line) or _PAIRS_RE.search(line)
+    if m is not None:
+        return [[int(x) for x in grp.split(',') if x]
+                for grp in m.group(1)[1:-1].split('},{')]
+    m = _IOTA_GROUPS_RE.search(line)
+    if m is not None:
+        n_groups, group_size = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(',')]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            ids = ids.transpose([int(p) for p in m.group(4).split(',')])
+        return ids.reshape(n_groups, group_size).tolist()
+    return None
+
+
+def _device_coords(device_id: int, sizes) -> tuple:
+    coords = []
+    for size in reversed(sizes):
+        coords.append(device_id % size)
+        device_id //= size
+    return tuple(reversed(coords))
+
+
+def attribute_collective_axes(hlo_text: str, mesh_shape: dict) -> dict:
+    """Per-mesh-axis collective {count, bytes} from partitioned HLO.
+
+    mesh_shape: ordered {axis: size} as `parallel.mesh.mesh_shape_dict`
+    returns it — device id = row-major index into that shape, which
+    holds for `make_mesh` over the default device order (the CPU-sim
+    meshes every sweep/test here runs on; a permuted physical mesh
+    would need the id->coords map threaded through instead).
+
+    Each collective op is classified by the mesh coordinates its
+    replica groups (or ppermute source/target pairs) vary over: a group
+    whose members differ only in the tp coordinate is tp traffic, the
+    gradient psum over dp and sp lands under 'dp+sp', and an op whose
+    groups never leave one device (or a mesh axis of size 1) counts as
+    'local'. Byte values are the same per-op transferred-shape upper
+    bounds `analyze_hlo_comm` reports, so the per-axis split sums to
+    (at most) its per-class totals. Ops with no group attribute span
+    every device and land on the joint label of all size>1 axes."""
+    axis_names = list(mesh_shape)
+    sizes = [int(mesh_shape[a]) for a in axis_names]
+    wide = [a for a, s in zip(axis_names, sizes) if s > 1]
+    out: dict = {}
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if m is None or m.group('phase') == '-done':
+            continue
+        shapes = []
+        for dtype, dims_s in _SHAPE_RE.findall(m.group('shapes')):
+            dims = [int(d) for d in dims_s.split(',') if d]
+            size = _DTYPE_BYTES.get(dtype, 4)
+            for d in dims:
+                size *= d
+            shapes.append(size)
+        if not shapes:
+            continue
+        nbytes = max(shapes)
+        groups = _collective_groups(line)
+        if groups is None:
+            label = '+'.join(wide) if wide else 'local'
+        else:
+            varying = set()
+            for grp in groups:
+                base = _device_coords(grp[0], sizes)
+                for member in grp[1:]:
+                    for name, a, b in zip(axis_names, base,
+                                          _device_coords(member, sizes)):
+                        if a != b:
+                            varying.add(name)
+            label = '+'.join(a for a in axis_names if a in varying) \
+                or 'local'
+        entry = out.setdefault(label, {}).setdefault(
+            m.group('cls'), dict(count=0, bytes=0))
+        entry['count'] += 1
+        entry['bytes'] += nbytes
+    return out
+
+
 def comm_payload(hlo_text: str, *, sp: int, ring_steps: int,
                  overlap: bool, exchange: bool,
-                 full_width_dim: Optional[int] = None) -> dict:
+                 full_width_dim: Optional[int] = None,
+                 mesh_shape: Optional[dict] = None) -> dict:
     """The schema'd `comm` record body (observability.schema kind='comm',
     minus run_id): ring configuration + the HLO-derived collective
     accounting. Attachable verbatim to bench records and flush payloads.
-    """
+    With `mesh_shape` (an ordered {axis: size} dict, see
+    `attribute_collective_axes`) the payload additionally carries
+    `axis_collectives` — the per-mesh-axis split the composed-mesh
+    budgets gate on — and the full-width all-gather scan becomes
+    axis-aware (only sp-varying gathers can rematerialize the
+    sequence; see `analyze_hlo_comm`)."""
     payload = dict(sp=sp, ring_steps=ring_steps, overlap=overlap,
                    exchange=exchange)
-    payload.update(analyze_hlo_comm(hlo_text, full_width_dim=full_width_dim))
+    payload.update(analyze_hlo_comm(hlo_text, full_width_dim=full_width_dim,
+                                    mesh_shape=mesh_shape))
+    if mesh_shape is not None:
+        payload['axis_collectives'] = attribute_collective_axes(
+            hlo_text, mesh_shape)
+        payload['mesh'] = dict(mesh_shape)
     return payload

@@ -84,6 +84,26 @@ def make_mesh(devices: Optional[Sequence] = None,
     return Mesh(mesh_devices, MESH_AXES)
 
 
+def mesh_shape_dict(mesh: Mesh) -> dict:
+    """Ordered {axis: size} for a mesh, in device-array order — the
+    shape `parallel.exchange.attribute_collective_axes` needs to map
+    HLO replica-group device ids back onto mesh axes (device id =
+    row-major index into this shape, which is how make_mesh lays
+    devices out)."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def mesh_points(n_devices: int = 8,
+                sizes: Sequence[int] = (1, 2, 4)) -> list:
+    """Every (dp, sp, tp) in sizes^3 whose product covers exactly
+    `n_devices` — the composed-sweep enumeration (ROADMAP item 4:
+    8 devices -> the 6 permutations of (1, 2, 4) plus (2, 2, 2)).
+    Sorted for a deterministic sweep order."""
+    return sorted((dp, sp, tp)
+                  for dp in sizes for sp in sizes for tp in sizes
+                  if dp * sp * tp == n_devices)
+
+
 # canonical partition specs for the data pytree of a training step
 def data_specs() -> dict:
     return dict(

@@ -11,7 +11,7 @@ audit for leaves no rule covers — a silently-replicated tensor is the
 classic way "sharded serving" degrades into every chip doing the same
 work.
 
-Three built-in rule sets over the existing ``('dp', 'sp', 'tp')`` mesh
+Four built-in rule sets over the existing ``('dp', 'sp', 'tp')`` mesh
 axes (`RULE_SETS`):
 
   * ``replicated`` — everything P() (the PR 2 serving default);
@@ -23,7 +23,13 @@ axes (`RULE_SETS`):
                      (one psum per block);
   * ``fsdp``       — every non-scalar shards dim 0 over the dp axis
                      (parameter memory / replica-count lever; optimizer
-                     state inherits the same specs for true FSDP).
+                     state inherits the same specs for true FSDP);
+  * ``composed``   — tp's Megatron placements verbatim, with the
+                     REMAINDER (norms, embeddings, gates) sharded dim-0
+                     over dp fsdp-style (the params/opt-state layout of
+                     the one dp x sp x tp mesh, ROADMAP item 4; dp
+                     stays off Megatron contraction dims — see
+                     `composed_rules`).
 
 `match_partition_rules(rules, params, mesh=...)` additionally audits
 each matched spec against the leaf shape and the mesh: a spec whose
@@ -142,8 +148,65 @@ def fsdp_rules(axis: str = 'dp') -> Rules:
     )
 
 
+def composed_rules(axis: str = 'tp', dp_axis: str = 'dp') -> Rules:
+    """TP + dp-sharded-remainder composition for the one dp x sp x tp
+    mesh (ROADMAP item 4): every Megatron-family leaf keeps exactly its
+    `tp_rules` placement, and the REMAINDER (norms, embeddings, gates —
+    everything tp leaves replicated) shards dim 0 over dp, fsdp-style.
+
+    dp deliberately does NOT touch the Megatron weights' contraction
+    dims. Sharding a contraction dim of a matmul whose other operand is
+    sequence-sharded (column-parallel [in, out] with `in` over dp while
+    activations ride P(dp, sp, None)) makes GSPMD rematerialize the
+    FULL sequence — an sp-group all-gather of the [b, n, ...]
+    activation per projection — which both breaks the all-gather-free
+    contract and dwarfs any memory saved on the weight. The composed
+    layout therefore is:
+
+      * radial final weights w3 / w3_{i}_{o} / wm{m}_{i}_{o}
+        [mid, IF, O]: P(None, None, tp); quantized `q` rides along,
+        `scale` [1, IF, O] matches (its size-1 mid dim would demote
+        noisily under any dp placement anyway).
+      * radial biases b3/bm [IF, O]: P(None, tp).
+      * column-parallel projections [in, out]: P(None, tp); their
+        per-output scales [1, out] likewise.
+      * row-parallel out-projections [in, out]: P(tp, None); the
+        per-output scale stays replicated (its epilogue runs on the
+        full post-psum output).
+      * everything else: fsdp-style dim-0 over dp, with the
+        quantized-scale guard from `fsdp_rules`. Dim-0 weight gathers
+        are prefetched parameter traffic, not sequence traffic — the
+        full-width scan in `exchange.analyze_hlo_comm` ignores dim 0
+        by construction.
+
+    Indivisible dims demote per-dimension under the mesh audit exactly
+    as in the single-axis sets — a (2,2,2) toy mesh with odd channel
+    counts degrades loudly, never silently. Like tp_rules/fsdp_rules
+    this is pure spec data; the explicit-aliasing step wiring that
+    makes the composed mesh actually RUN on jax 0.4.37 lives in
+    `parallel.sharding.composed_state_shardings`."""
+    col = '|'.join(_COLUMN_PARALLEL)
+    row = '|'.join(_ROW_PARALLEL)
+    return (
+        (r'(^|/)(?:w3(_\d+_\d+)?|wm\d+_\d+_\d+)/scale$',
+         P(None, None, axis), 3),
+        (r'(^|/)w3(_\d+_\d+)?(/q)?$', P(None, None, axis), 3),
+        (r'(^|/)b3(_\d+_\d+)?$', P(None, axis), 2),
+        (r'(^|/)wm\d+_\d+_\d+(/q)?$', P(None, None, axis), 3),
+        (r'(^|/)bm\d+_\d+_\d+$', P(None, axis), 2),
+        (rf'(^|/)(?:{col})/w\d+/scale$', P(None, axis), 2),
+        (rf'(^|/)(?:{col})/w\d+(/q)?$', P(None, axis), 2),
+        (rf'(^|/)(?:{row})/w\d+(/q)?$', P(axis, None), 2),
+        (rf'(^|/)(?:{row})/w\d+/scale$', P(), 2),
+        # remainder: fsdp dim-0 over dp (same scale guard as fsdp_rules)
+        (r'(^|/)(?:w\d+(?:_\d+_\d+)?|wm\d+_\d+_\d+|kernel)/scale$',
+         P()),
+        (r'.*', P(dp_axis)),
+    )
+
+
 RULE_SETS = dict(replicated=replicated_rules, tp=tp_rules,
-                 fsdp=fsdp_rules)
+                 fsdp=fsdp_rules, composed=composed_rules)
 
 
 def resolve_rules(rules: Union[str, Rules],

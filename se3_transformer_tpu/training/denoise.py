@@ -74,6 +74,16 @@ class DenoiseConfig:
     # this knob, opt state replicated on every chip (2x param memory)
     # despite the PR 10 specs existing. Requires a mesh with dp>1.
     fsdp: bool = False
+    # composed dp x sp x tp parallelism (ROADMAP item 4): params AND
+    # optimizer state over (dp, tp) via the parallel.rules 'composed'
+    # set, with the step's in/out shardings pinned to those placements
+    # (parallel.sharding.composed_state_shardings — the explicit-
+    # aliasing route around the jax-0.4.37 GSPMD donation bug, which
+    # otherwise kills the dp>1/sp>1/tp>1 mesh with an INTERNAL
+    # aliased-size error). Batch placement is unchanged (dp over batch,
+    # sp over nodes via shard_batch). Supersedes tensor_parallel/fsdp
+    # when set; requires a mesh.
+    composed: bool = False
     log_every: int = 1
     # first-class telemetry (observability package): thread an on-device
     # MetricAccumulator through the jitted step (zero host syncs on hot
@@ -191,6 +201,18 @@ class DenoiseTrainer:
         self.tensor_parallel = bool(cfg.tensor_parallel
                                     and self.mesh is not None)
         self.fsdp = bool(cfg.fsdp and self.mesh is not None)
+        self.composed = bool(cfg.composed and self.mesh is not None)
+        if self.composed:
+            # the composed route subsumes both single-axis modes: params
+            # carry tp AND dp placements, opt state inherits them, and
+            # the pinned-shardings step covers the donation aliasing
+            self.tensor_parallel = self.fsdp = False
+        if cfg.composed and self.mesh is None:
+            import warnings
+            warnings.warn('composed=True without a mesh — falling back '
+                          'to the single-device step; build the trainer '
+                          'with make_mesh(dp=..., sp=..., tp=...)',
+                          stacklevel=2)
         self.opt_state_specs = None   # filled by init()/restore() (fsdp)
         if cfg.tensor_parallel and (
                 self.mesh is None or self.mesh.shape.get('tp', 1) == 1):
@@ -255,9 +277,11 @@ class DenoiseTrainer:
         return make_sharded_train_step(
             self.loss_fn, self.optimizer, **kwargs)
 
-    def _pin_fsdp_step(self):
+    def _pin_state_step(self):
         """Rebuild the step with in/out shardings pinned to the placed
-        params/opt-state (called from init()/restore() under fsdp)."""
+        params/opt-state (called from init()/restore() under fsdp and
+        under the composed dp x sp x tp mode — the explicit-aliasing
+        route around the GSPMD donation bug on multi-axis meshes)."""
         shardings = tuple(
             jax.tree_util.tree_map(lambda leaf: leaf.sharding, tree)
             for tree in (self.params, self.opt_state))
@@ -275,7 +299,17 @@ class DenoiseTrainer:
         self.params = init_fn(
             sub, batch['seqs'], noised, mask=batch['masks'],
             adj_mat=batch['adj_mat'], return_type=1)['params']
-        if self.fsdp:
+        if self.composed:
+            # composed dp x sp x tp: params AND opt state over (dp, tp)
+            # via the 'composed' rule set, then the step repinned with
+            # both placements as in/out shardings (scalars like adam's
+            # count must be mesh-placed too, or the pin trips an
+            # incompatible-devices error)
+            from ..parallel.sharding import composed_state_shardings
+            self.params, self.opt_state, _ = composed_state_shardings(
+                self.params, self.optimizer.init(self.params), self.mesh)
+            self._pin_state_step()
+        elif self.fsdp:
             # true FSDP: params dim-0 over dp (fsdp rule set), then the
             # optimizer state through shard_opt_state so adam's mu/nu
             # inherit each param's AUDITED spec — the step factory's
@@ -287,7 +321,7 @@ class DenoiseTrainer:
                                        rules='fsdp')
             self.opt_state, self.opt_state_specs = shard_opt_state(
                 self.optimizer.init(self.params), self.params, self.mesh)
-            self._pin_fsdp_step()
+            self._pin_state_step()
         elif self.tensor_parallel:
             from ..parallel.sharding import shard_params
             self.params = shard_params(self.params, self.mesh)
@@ -306,7 +340,14 @@ class DenoiseTrainer:
         shards — not replicate 2x the param memory on every chip until
         the first step reshards them implicitly."""
         params, opt_state, step_count = state
-        if self.fsdp:
+        if self.composed:
+            from ..parallel.sharding import composed_state_shardings
+            self.params, self.opt_state, _ = composed_state_shardings(
+                params, opt_state, self.mesh)
+            self.step_count = int(step_count)
+            self._pin_state_step()
+            return
+        elif self.fsdp:
             from ..parallel.rules import shard_opt_state
             from ..parallel.sharding import shard_params
             params = shard_params(params, self.mesh, rules='fsdp')
@@ -314,7 +355,7 @@ class DenoiseTrainer:
                 opt_state, params, self.mesh)
             self.params, self.opt_state = params, opt_state
             self.step_count = int(step_count)
-            self._pin_fsdp_step()
+            self._pin_state_step()
             return
         elif self.tensor_parallel:
             from ..parallel.rules import shard_opt_state

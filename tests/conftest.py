@@ -94,6 +94,7 @@ _HEAVY_TESTS = {
     'test_translation_invariance',
     'test_shared_radial_group_path',
     'test_combined_ring_tp_dp_train_step',
+    'test_composed_mesh_step_matches_dp_only',
     'test_dim_out_and_output_degrees',
     'test_sparse_neighbor_noise_rng_threading',
     'test_num_positions_embedding',

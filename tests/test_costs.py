@@ -458,6 +458,51 @@ def test_perf_gate_group_by_judges_every_axis_point(tmp_path,
     capsys.readouterr()
 
 
+def test_perf_gate_group_by_multi_key_no_cross_point_masking(
+        tmp_path, scripts_path, capsys):
+    """Comma-separated group_by keys one group per MESH POINT: a clean
+    (2,2,2) row must not mask a regressed (4,1,2) row, even though the
+    two share every individual axis value with some clean row. Grouped
+    by any single axis this stream would pass — the regressed point's
+    sp=1 is shadowed only when the full (dp,sp,tp) tuple is the key."""
+    import perf_gate
+    budgets = dict(version=1, budgets=[dict(
+        name='mesh_ag_free_every_point', kind='mesh_sweep',
+        field='comm.all_gather_free', equals=True,
+        group_by='dp,sp,tp')])
+    bpath = tmp_path / 'b.json'
+    bpath.write_text(json.dumps(budgets))
+
+    def run(records):
+        rpath = tmp_path / 'r.jsonl'
+        with open(rpath, 'w') as f:
+            for r in records:
+                f.write(json.dumps(r) + '\n')
+        return perf_gate.main([str(rpath), '--budgets', str(bpath)])
+
+    def row(dp, sp, tp, clean):
+        return dict(kind='mesh_sweep', dp=dp, sp=sp, tp=tp,
+                    comm=dict(all_gather_free=clean))
+
+    dirty_412 = [row(4, 1, 2, False), row(2, 2, 2, True),
+                 row(4, 2, 1, True), row(1, 2, 4, True)]
+    assert run(dirty_412) == 1
+    out = capsys.readouterr().out
+    assert 'dp,sp,tp-groups breach' in out and "('4', '1', '2')" in out
+
+    # the same stream with a LATER healed (4,1,2) row clears its group
+    assert run(dirty_412 + [row(4, 1, 2, True)]) == 0
+
+    # single-key grouping on sp WOULD mask it: (1,2,4)'s sp=2 row is
+    # latest for sp=2 and (4,1,2)'s dirty sp=1... still caught; but
+    # grouped by dp alone the clean (4,2,1) shadows dirty (4,1,2) —
+    # the exact masking the multi-key form exists to prevent
+    budgets['budgets'][0]['group_by'] = 'dp'
+    bpath.write_text(json.dumps(budgets))
+    assert run(dirty_412) == 0
+    capsys.readouterr()
+
+
 def test_perf_gate_committed_budgets_are_loadable(scripts_path):
     # the committed PERF_BUDGETS.json must stay structurally valid:
     # every budget names a kind, a field, and exactly one constraint

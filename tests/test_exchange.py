@@ -9,10 +9,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from se3_transformer_tpu.parallel import make_mesh
+from se3_transformer_tpu.parallel import make_mesh, mesh_shape_dict
 from se3_transformer_tpu.parallel.exchange import (
-    analyze_hlo_comm, comm_payload, exchange_index_select, exchange_scope,
-    neighbor_gather, rowwise_gather,
+    analyze_hlo_comm, attribute_collective_axes, comm_payload,
+    exchange_index_select, exchange_scope, neighbor_gather, rowwise_gather,
 )
 from se3_transformer_tpu.parallel.ring import ring_knn
 from se3_transformer_tpu.utils.helpers import batched_index_select
@@ -212,6 +212,98 @@ def test_analyze_hlo_comm_ignores_parameter_all_gathers():
     assert info['collectives']['all-gather']['count'] == 1
     assert info['full_width_all_gathers'] == []
     assert info['all_gather_free']
+
+
+_MESH222 = dict(dp=2, sp=2, tp=2)  # device id = d*4 + s*2 + t
+
+
+def test_attribute_collective_axes_explicit_groups():
+    """Explicit replica_groups / source_target_pairs decode to the mesh
+    axis whose coordinate varies inside each group — the per-axis split
+    the composed-mesh budgets gate on."""
+    hlo = (
+        # members differ by 4 = dp stride on the 2x2x2 mesh
+        '  %ar0 = f32[8,16]{1,0} all-reduce(f32[8,16] %a), '
+        'replica_groups={{0,4},{1,5},{2,6},{3,7}}, '
+        'use_global_device_ids=true\n'
+        # members differ by 2 = sp stride
+        '  %ar1 = f32[4,16]{1,0} all-reduce(f32[4,16] %b), '
+        'replica_groups={{0,2},{1,3},{4,6},{5,7}}, '
+        'use_global_device_ids=true\n'
+        # ppermute between tp neighbors (differ by 1)
+        '  %cp = f32[2,16]{1,0} collective-permute(f32[2,16] %c), '
+        'source_target_pairs={{0,1},{1,0},{2,3},{3,2}}\n'
+        # one group spanning dp AND sp (the gradient psum shape)
+        '  %ar2 = f32[8,8]{1,0} all-reduce(f32[8,8] %d), '
+        'replica_groups={{0,2,4,6},{1,3,5,7}}, '
+        'use_global_device_ids=true\n')
+    attr = attribute_collective_axes(hlo, _MESH222)
+    assert attr['dp']['all-reduce'] == dict(count=1, bytes=4 * 8 * 16)
+    assert attr['sp']['all-reduce'] == dict(count=1, bytes=4 * 4 * 16)
+    assert attr['tp']['collective-permute'] == \
+        dict(count=1, bytes=4 * 2 * 16)
+    assert attr['dp+sp']['all-reduce'] == dict(count=1, bytes=4 * 8 * 8)
+
+
+def test_attribute_collective_axes_iota_and_fallbacks():
+    """The iota replica_groups form (with and without a transpose)
+    decodes like the explicit one; singleton groups land on 'local',
+    and an op with no group attribute spans every size>1 axis."""
+    hlo = (
+        # [4,2]<=[8]: groups {0,1},{2,3},{4,5},{6,7} -> tp pairs
+        '  %ar0 = f32[2,8]{1,0} all-reduce(f32[2,8] %a), '
+        'replica_groups=[4,2]<=[8]\n'
+        # [4,2]<=[4,2]T(1,0): groups {0,2},{4,6},{1,3},{5,7} -> sp
+        '  %ar1 = f32[2,4]{1,0} all-reduce(f32[2,4] %b), '
+        'replica_groups=[4,2]<=[4,2]T(1,0)\n'
+        # singleton groups: no coordinate varies
+        '  %ar2 = f32[2,2]{1,0} all-reduce(f32[2,2] %c), '
+        'replica_groups={{0},{1},{2},{3},{4},{5},{6},{7}}\n'
+        # no group attribute at all
+        '  %ar3 = f32[2,1]{1,0} all-reduce(f32[2,1] %d)\n')
+    attr = attribute_collective_axes(hlo, _MESH222)
+    assert attr['tp']['all-reduce'] == dict(count=1, bytes=4 * 2 * 8)
+    assert attr['sp']['all-reduce'] == dict(count=1, bytes=4 * 2 * 4)
+    assert attr['local']['all-reduce'] == dict(count=1, bytes=4 * 2 * 2)
+    assert attr['dp+sp+tp']['all-reduce'] == dict(count=1, bytes=4 * 2)
+    # size-1 axes never appear in a label: same no-group op on a
+    # dp-only mesh is plain dp traffic
+    attr_dp = attribute_collective_axes(
+        '  %ar = f32[2,1]{1,0} all-reduce(f32[2,1] %d)\n',
+        dict(dp=8, sp=1, tp=1))
+    assert set(attr_dp) == {'dp'}
+
+
+def test_attribute_collective_axes_live_composed_grad():
+    """On a real 2x2x2 mesh, the weight-gradient psum of a dp/sp-sharded
+    batch against a tp-column-sharded weight shows up as separate dp and
+    sp all-reduces (XLA splits the group product), and comm_payload
+    carries the split + mesh when asked."""
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def loss(x, w):
+        return jnp.sum(jnp.einsum('bnd,dk->bnk', x, w) ** 2)
+
+    xs = NamedSharding(mesh, P('dp', 'sp', None))
+    ws = NamedSharding(mesh, P(None, 'tp'))
+    x = jax.device_put(np.ones((4, 8, 16), np.float32), xs)
+    w = jax.device_put(np.ones((16, 16), np.float32), ws)
+    hlo = jax.jit(jax.grad(loss, argnums=1), in_shardings=(xs, ws),
+                  out_shardings=ws).lower(x, w).compile().as_text()
+    shape = mesh_shape_dict(mesh)
+    assert shape == _MESH222
+    attr = attribute_collective_axes(hlo, shape)
+    crossed = set(attr) - {'local'}
+    assert crossed  # the psum exists
+    # every label only names real mesh axes, and batch-axis traffic is
+    # attributed to dp/sp (never tp: the tp shards own disjoint columns)
+    assert all(set(lbl.split('+')) <= {'dp', 'sp'} for lbl in crossed)
+    payload = comm_payload(hlo, sp=2, ring_steps=2, overlap=True,
+                           exchange=True, full_width_dim=8,
+                           mesh_shape=shape)
+    assert payload['axis_collectives'] == attr
+    assert payload['mesh'] == _MESH222
 
 
 def test_comm_record_schema():

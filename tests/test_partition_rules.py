@@ -12,8 +12,8 @@ from jax.sharding import PartitionSpec as P
 
 from se3_transformer_tpu.parallel import make_mesh
 from se3_transformer_tpu.parallel.rules import (
-    RULE_SETS, fsdp_rules, match_partition_rules, place_with_rules,
-    replicated_rules, resolve_rules, tp_rules,
+    RULE_SETS, composed_rules, fsdp_rules, match_partition_rules,
+    place_with_rules, replicated_rules, resolve_rules, tp_rules,
 )
 
 
@@ -154,8 +154,78 @@ def test_tp_and_fsdp_specs_on_two_axis_mesh():
     assert all(s == P() for s in repl.values())
 
 
+def test_composed_specs_on_three_axis_mesh():
+    """The composed set on the real (dp, sp, tp) mesh: Megatron leaves
+    keep their tp_rules placements EXACTLY (dp must stay off contraction
+    dims — a dp-sharded [in, out] projection forces GSPMD to
+    rematerialize the sp-sharded sequence, see composed_rules), the
+    remainder shards dim 0 over dp, and NO leaf goes unmatched — the
+    audit runs with the default on_unmatched='error'."""
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    params = _model_like_tree()
+    # the v2 per-m radial family rides the same rules
+    params['layers_0']['wm0_0_1'] = np.zeros((16, 12, 8), np.float32)
+    params['layers_0']['bm0_0_1'] = np.zeros((12, 8), np.float32)
+
+    specs = _flat(match_partition_rules(composed_rules(), params,
+                                        mesh=mesh))
+    tp = _flat(match_partition_rules(tp_rules(), params, mesh=mesh))
+
+    # Megatron families: identical to tp_rules, leaf by leaf
+    for key in ("['layers_0']['w3']", "['layers_0']['w3_0_1']",
+                "['layers_0']['b3']", "['layers_0']['to_q']['w1']",
+                "['layers_0']['to_out']['w1']",
+                "['layers_0']['wm0_0_1']", "['layers_0']['bm0_0_1']"):
+        assert specs[key] == tp[key], (key, specs[key], tp[key])
+    assert specs["['layers_0']['w3']"] == P(None, None, 'tp')
+    assert specs["['layers_0']['wm0_0_1']"] == P(None, None, 'tp')
+    assert specs["['layers_0']['to_q']['w1']"] == P(None, 'tp')
+    assert specs["['layers_0']['to_out']['w1']"] == P('tp', None)
+
+    # remainder: fsdp-style dim 0 over dp
+    assert specs["['layers_0']['norm']['g']"] == P('dp')
+    assert specs["['layers_0']['to_out']['b1']"] == P('dp')
+    assert specs["['layers_0']['scalar']"] == P()
+
+    # dp never appears on a Megatron leaf's spec at all
+    megatron = [v for k, v in specs.items()
+                if any(t in k for t in ('w3', 'wm0', 'b3', 'bm0',
+                                        'to_q', "to_out']['w1"))]
+    assert megatron and all('dp' not in [a for a in s if a]
+                            for s in megatron)
+
+
+def test_composed_quant_and_demotion_on_three_axis_mesh():
+    """Composed rules descend into QuantTensor leaves (q shards like the
+    fp32 weight, scales keep the tp output axis or replicate for the
+    row pair) and indivisible remainder dims demote LOUDLY, never
+    silently — with every leaf still matched by some rule."""
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    params = _quantized_model_like_tree()
+    # odd-dim-0 remainder leaf: catch-all P(dp) must demote with a
+    # summary warning on the (2,2,2) mesh
+    params['layers_0']['embed'] = np.zeros((3, 8), np.float32)
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        specs = _flat(match_partition_rules(composed_rules(), params,
+                                            mesh=mesh))
+    assert any('demoted' in str(x.message) and 'embed' in str(x.message)
+               for x in w), [str(x.message) for x in w]
+
+    assert specs["['layers_0']['w3'].q"] == P(None, None, 'tp')
+    assert specs["['layers_0']['w3'].scale"] == P(None, None, 'tp')
+    assert specs["['layers_0']['w3_0_1'].q"] == P(None, None, 'tp')
+    assert specs["['layers_0']['to_q']['w0'].q"] == P(None, 'tp')
+    assert specs["['layers_0']['to_q']['w0'].scale"] == P(None, 'tp')
+    assert specs["['layers_0']['to_out']['w0'].q"] == P('tp', None)
+    assert specs["['layers_0']['to_out']['w0'].scale"] == P()
+    # demoted from P('dp') per-dimension: the dp entry is now None
+    assert specs["['layers_0']['embed']"] == P(None)
+
+
 def test_resolve_rules_names_and_passthrough():
-    assert set(RULE_SETS) == {'replicated', 'tp', 'fsdp'}
+    assert set(RULE_SETS) == {'replicated', 'tp', 'fsdp', 'composed'}
     assert resolve_rules('tp') == tp_rules()
     assert resolve_rules('fsdp', axis='sp') == fsdp_rules(axis='sp')
     explicit = ((r'.*', P()),)

@@ -126,11 +126,20 @@ def test_tensor_parallel_params_partitioned_and_match_replicated():
 def test_combined_ring_tp_dp_train_step():
     """3D parallelism in one step: dp-sharded batch, ring (sp) neighbor
     selection inside the traced forward, tp-partitioned params — all in a
-    single jitted update with finite loss and params still partitioned."""
+    single jitted update with finite loss and params still partitioned.
+
+    Regression pin for the composed route: the old shard_params +
+    tensor_parallel=True wiring died in jax 0.4.37's GSPMD donation
+    aliasing (INTERNAL: unsupported aliasing) as soon as tp was live
+    next to dp; `composed_state_shardings` places params AND opt state
+    (scalars included) and repins the step with both placements as
+    in/out shardings, which is the only configuration that compiles AND
+    runs. Two steps, because donation bugs often only bite on the
+    second call (the first consumes the originally-placed buffers)."""
     import optax
     from se3_transformer_tpu import SE3TransformerModule
-    from se3_transformer_tpu.parallel import shard_params
-    from se3_transformer_tpu.parallel.sharding import make_sharded_train_step
+    from se3_transformer_tpu.parallel.sharding import (
+        composed_state_shardings, make_sharded_train_step)
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = make_mesh(dp=2, sp=2, tp=2)
@@ -147,9 +156,9 @@ def test_combined_ring_tp_dp_train_step():
     params = jax.jit(module.init, static_argnames=('return_type',))(
         jax.random.PRNGKey(0), feats, coors, mask=mask,
         return_type=1)['params']
-    params = shard_params(params, mesh)
     opt = optax.adam(1e-3)
-    opt_state = jax.jit(opt.init)(params)
+    params, opt_state, shardings = composed_state_shardings(
+        params, opt.init(params), mesh)
 
     def loss_fn(params, batch, key):
         noise = jax.random.normal(key, batch['coors'].shape)
@@ -160,21 +169,105 @@ def test_combined_ring_tp_dp_train_step():
         return ((out - noise[:, :, None, :]) ** 2).mean(), {}
 
     step = make_sharded_train_step(loss_fn, opt, mesh=mesh,
-                                   tensor_parallel=True)
+                                   state_shardings=shardings)
     batch = {
         'feats': jax.device_put(feats, NamedSharding(mesh, P('dp', 'sp', None))),
         'coors': jax.device_put(coors, NamedSharding(mesh, P('dp', 'sp', None))),
         'mask': jax.device_put(mask, NamedSharding(mesh, P('dp', 'sp'))),
     }
-    params, opt_state, loss, _ = step(params, opt_state, batch,
-                                      jax.random.PRNGKey(1))
-    assert np.isfinite(float(loss))
+    for i in range(2):  # donation rebinds state each call
+        params, opt_state, loss, _ = step(params, opt_state, batch,
+                                          jax.random.PRNGKey(1 + i))
+        assert np.isfinite(float(loss)), f'non-finite loss at step {i}'
 
-    # tp partitioning survived the update
+    # tp partitioning survived the updates
     n_sharded = sum(
         1 for _, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
         if 'tp' in str(getattr(leaf.sharding, 'spec', '')))
     assert n_sharded >= 4, f'only {n_sharded} params tp-sharded after step'
+
+
+def test_composed_mesh_step_matches_dp_only():
+    """Fast tier-1 sibling of the combined ring/tp/dp step: on the full
+    2x2x2 mesh the composed route (params/opt state over (dp, tp) with
+    pinned in/out shardings) must produce the SAME update as a plain
+    dp-only data-parallel step — placement is an execution detail, not
+    math. Small model, no ring, one step: this is the cheap canary that
+    keeps the composed route compiling in every tier-1 run."""
+    import optax
+    from se3_transformer_tpu import SE3TransformerModule
+    from se3_transformer_tpu.parallel.sharding import (
+        composed_state_shardings, make_sharded_train_step)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    module = SE3TransformerModule(dim=8, depth=1, attend_self=True,
+                                  num_neighbors=4, num_degrees=2,
+                                  output_degrees=2, heads=2, dim_head=4)
+    rng = np.random.RandomState(0)
+    b, n = 2, 16
+    feats = jnp.asarray(rng.normal(size=(b, n, 8)), np.float32)
+    coors = jnp.asarray(rng.normal(size=(b, n, 3)), np.float32)
+    mask = jnp.ones((b, n), bool)
+
+    params0 = jax.jit(module.init, static_argnames=('return_type',))(
+        jax.random.PRNGKey(0), feats, coors, mask=mask,
+        return_type=1)['params']
+    opt = optax.adam(1e-3)
+    # noise rides in the batch, NOT drawn inside the step: on this jax,
+    # jax.random.normal traced under pjit yields sharding-DEPENDENT
+    # values (threefry_partitionable=False), so in-step rng would make
+    # the two arms denoise different targets and parity meaningless
+    noise0 = jax.random.normal(jax.random.PRNGKey(1), coors.shape)
+
+    def loss_fn(params, batch, key):
+        del key
+        noise = batch['noise']
+        out = module.apply({'params': params}, batch['feats'],
+                           batch['coors'] + noise, mask=batch['mask'],
+                           return_type=1)
+        return ((out - noise[:, :, None, :]) ** 2).mean(), {}
+
+    def run(mesh, composed):
+        # each arm gets its own buffers: the steps donate their state,
+        # and a device_put onto a replicated spec can ALIAS the source
+        # buffer — donating the placed tree would delete params0's
+        # leaves out from under the other arm
+        params = jax.tree_util.tree_map(jnp.array, params0)
+        if composed:
+            params, opt_state, shardings = composed_state_shardings(
+                params, opt.init(params), mesh)
+            step = make_sharded_train_step(loss_fn, opt, mesh=mesh,
+                                           state_shardings=shardings)
+        else:
+            opt_state = jax.jit(opt.init)(params)
+            step = make_sharded_train_step(loss_fn, opt, mesh=mesh)
+        node = P('dp', 'sp', None) if composed else P('dp', None, None)
+        flat = P('dp', 'sp') if composed else P('dp', None)
+        batch = {
+            'feats': jax.device_put(feats, NamedSharding(mesh, node)),
+            'coors': jax.device_put(coors, NamedSharding(mesh, node)),
+            'noise': jax.device_put(noise0, NamedSharding(mesh, node)),
+            'mask': jax.device_put(mask, NamedSharding(mesh, flat)),
+        }
+        params, _, loss, _ = step(params, opt_state, batch,
+                                  jax.random.PRNGKey(1))
+        return float(loss), params
+
+    loss_c, params_c = run(make_mesh(dp=2, sp=2, tp=2), composed=True)
+    loss_d, params_d = run(make_mesh(jax.devices()[:2], dp=2, sp=1, tp=1),
+                           composed=False)
+
+    assert np.isfinite(loss_c)
+    assert abs(loss_c - loss_d) < 1e-5 * max(1.0, abs(loss_d))
+    for a, b_ in zip(jax.tree_util.tree_leaves(params_c),
+                     jax.tree_util.tree_leaves(params_d)):
+        assert np.allclose(np.asarray(a), np.asarray(b_), atol=1e-5)
+
+    # the composed arm really partitioned over tp (not cosmetic)
+    n_tp = sum(
+        1 for _, leaf in jax.tree_util.tree_flatten_with_path(params_c)[0]
+        if 'tp' in str(getattr(leaf.sharding, 'spec', '')))
+    assert n_tp >= 4, f'only {n_tp} params tp-sharded'
 
 
 def test_tensor_parallel_shared_radial_group_params():

@@ -1,5 +1,5 @@
 # Common entry points (see README.md for details)
-.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke serve-fleet-smoke slo-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke v2-smoke flash-smoke assembly-smoke mesh-smoke chaos-smoke train-chaos-smoke quant-smoke perf-gate clean-cache
+.PHONY: test test-fast bench denoise cookbook molecular profile tpu-checks obs-smoke serve-smoke serve-multi-smoke serve-fleet-smoke slo-smoke transport-smoke pipeline-smoke tune-smoke ring-smoke profile-smoke so2-smoke v2-smoke flash-smoke assembly-smoke mesh-smoke chaos-smoke train-chaos-smoke quant-smoke perf-gate clean-cache
 
 test:              ## full suite on the simulated 8-device CPU mesh
 	python -m pytest tests/ -q
@@ -106,6 +106,14 @@ serve-fleet-smoke: ## cross-host fleet gate (docs/ROBUSTNESS.md "Fleet fault dom
 	python scripts/obs_report.py /tmp/fleet_chaos.jsonl --validate --require fleet --out /tmp/fleet_chaos_report.json
 	python scripts/perf_gate.py /tmp/fleet_chaos.jsonl
 	python scripts/fleet_chaos_smoke.py --weaken noexclude >/tmp/fleet_weaken.log 2>&1; test $$? -eq 1 || { echo "serve-fleet-smoke weakened arm did NOT fire with rc=1 — nulled host exclusion went undetected; output:"; cat /tmp/fleet_weaken.log; exit 1; }  # rc=1 is the gates FIRING on the dead host eating traffic; any other rc (crash, argparse) fails loudly with the evidence
+
+transport-smoke:   ## transport A/B gate (docs/ROBUSTNESS.md "Transport"): the SAME seeded closed-loop workload through legacy connect-per-call JSON vs pooled multiplexed binary framing — zero errors / frame errors / mid-run reconnects, in-flight depth > 1 (--require transport), and the committed QPS floor (>=3x) + p99 + wire-bytes ceilings judge the banked transport record; then the --inject-regression arm must exit rc==1, proving those budgets fire
+	rm -f /tmp/transport_ab.jsonl
+	python scripts/transport_loadgen.py --metrics /tmp/transport_ab.jsonl
+	python scripts/obs_report.py /tmp/transport_ab.jsonl --validate --require transport --out /tmp/transport_ab_report.json
+	python scripts/perf_gate.py /tmp/transport_ab.jsonl
+	rm -f /tmp/transport_inject.jsonl
+	python scripts/transport_loadgen.py --metrics /tmp/transport_inject.jsonl --inject-regression >/tmp/transport_inject.log 2>&1; test $$? -eq 1 || { echo "transport-smoke injected arm did NOT fire with rc=1 — a vanished QPS win / blown p99 / JSON-fat wire went undetected; output:"; cat /tmp/transport_inject.log; exit 1; }  # rc=1 is the committed budgets FIRING on the corrupted record; any other rc (crash, argparse, rc=2 budgets-not-wired) fails loudly with the evidence
 
 slo-smoke:         ## fleet observability gate (docs/OBSERVABILITY.md "Fleet dashboard"): 2 traced in-process hosts under seeded transport faults — every resolved request yields ONE complete single-root span tree (zero orphans), redispatched requests show multi-host traces reconciling with the cross_host_retries counter, merged-histogram fleet percentiles + availability land in schema'd trace/slo records (--require trace,slo), the dashboard renders, and the fleet perf budgets judge the stream; then the --inject-regression arm (fleet-side attempt spans discarded) must exit rc==1, proving the completeness gates fire
 	rm -f /tmp/slo_smoke.jsonl

@@ -103,6 +103,11 @@ def parse_args(argv=None):
     ap.add_argument('--checkpoint', default=None, help=argparse.SUPPRESS)
     ap.add_argument('--metrics', type=str, default=None)
     ap.add_argument('--out', type=str, default=None)
+    ap.add_argument('--transport', choices=('binary', 'legacy'),
+                    default='binary',
+                    help='fleet wire under chaos: the pooled '
+                         'multiplexed binary framing (default) or the '
+                         'legacy connect-per-call JSON escape hatch')
     ap.add_argument('--weaken', choices=('none', 'noexclude'),
                     default='none',
                     help="'noexclude': null host exclusion (placement "
@@ -137,7 +142,7 @@ def main(argv=None):
         SchemaError, validate_stream,
     )
     from se3_transformer_tpu.serving import (
-        FleetRouter, HealthConfig, SocketTransport,
+        BinaryTransport, FleetRouter, HealthConfig, SocketTransport,
     )
     from se3_transformer_tpu.training.checkpoint import CheckpointManager
 
@@ -172,7 +177,8 @@ def main(argv=None):
             timeout_s=args.timeout_s, max_retries=1,
             checkpoint=ckpt_dir, checkpoint_step=1,
             metrics=os.path.join(tmp, f'host_{i}.jsonl'),
-            poison_step=2 if i == canary else None)
+            poison_step=2 if i == canary else None,
+            transport=args.transport)
 
     print(f'spawning {args.hosts} host processes '
           f'(canary={canary} poisoned at step 2)...')
@@ -195,8 +201,13 @@ def main(argv=None):
     inj = FaultInjector(seed=args.seed)
     inj.plan('transport', 'latency', every=11, latency_s=0.02)
     inj.plan('transport', 'drop', at=(5,), match=dict(method='infer'))
-    transports = {i: SocketTransport('127.0.0.1', port,
-                                     fault_injector=inj)
+    # the chaos gates (SIGKILL reconnect, seeded drop/latency faults,
+    # canary rollback) run on the production binary wire by default —
+    # --transport legacy re-runs them on the JSON escape hatch
+    transport_cls = (BinaryTransport if args.transport == 'binary'
+                     else SocketTransport)
+    transports = {i: transport_cls('127.0.0.1', port,
+                                   fault_injector=inj)
                   for i, port in enumerate(ports)}
     health = HealthConfig(quarantine_after=3, recover_after=2,
                           probe_backoff_s=0.25, probe_backoff_max_s=2.0)

@@ -546,6 +546,47 @@ def _gate_mesh_sweep(records):
     return True
 
 
+def _gate_transport(records):
+    recs = [r for r in records if r.get('kind') == 'transport']
+    if not recs:
+        print('TRANSPORT GATE: no transport records in the stream '
+              '(was scripts/transport_loadgen.py run?)', file=sys.stderr)
+        return False
+    r = recs[-1]
+    arms = r.get('arms') or {}
+    bad = []
+    for name in ('legacy', 'binary'):
+        arm = arms.get(name) or {}
+        if not arm.get('requests'):
+            bad.append(f'{name} arm served no requests — the A/B '
+                       f'compares nothing')
+        elif arm.get('errors'):
+            bad.append(f'{name} arm had {arm["errors"]} errors on a '
+                       f'fault-free workload')
+    tstats = r.get('transport') or {}
+    if tstats.get('frame_errors'):
+        bad.append(f'{tstats["frame_errors"]} frame errors on a '
+                   f'clean wire — the framing is corrupting data')
+    if tstats.get('reconnects'):
+        bad.append(f'{tstats["reconnects"]} reconnects with no host '
+                   f'restart — connections are not persisting')
+    if (tstats.get('peak_in_flight') or 0) < 2:
+        bad.append(f'binary peak_in_flight='
+                   f'{tstats.get("peak_in_flight")} — nothing ever '
+                   f'multiplexed, the pooled arm degenerated to '
+                   f'serial calls')
+    if bad:
+        print(f'TRANSPORT GATE: ' + '; '.join(bad), file=sys.stderr)
+        return False
+    print(f'transport gate ok: binary {r.get("qps_binary_vs_legacy")}x '
+          f'qps vs legacy, p99 ratio {r.get("p99_binary_vs_legacy")}, '
+          f'wire-bytes ratio {r.get("wire_bytes_binary_vs_legacy")}, '
+          f'peak in-flight {tstats.get("peak_in_flight")}, zero frame '
+          f'errors (the numeric floors/ceilings are enforced by '
+          f'scripts/perf_gate.py)', file=sys.stderr)
+    return True
+
+
 _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       tune=_gate_tune, cost=_gate_cost,
                       profile=_gate_profile, serve=_gate_serve,
@@ -555,7 +596,8 @@ _REQUIRE_GATES = dict(pipeline=_gate_pipeline, comm=_gate_comm,
                       fleet=_gate_fleet, quant_ab=_gate_quant_ab,
                       trace=_gate_trace, slo=_gate_slo,
                       assembly=_gate_assembly,
-                      mesh_sweep=_gate_mesh_sweep)
+                      mesh_sweep=_gate_mesh_sweep,
+                      transport=_gate_transport)
 
 
 def main(argv=None):

@@ -92,13 +92,18 @@ DEFAULT_BUDGETS = os.path.join(REPO, 'PERF_BUDGETS.json')
 # every-point all-gather-free proof bit, the per-axis ppermute /
 # all-reduce byte ceilings, and the per-shard memory ceiling are judged
 # by a plain `make perf-gate`.
+# TRANSPORT_AB.jsonl: the banked `make transport-smoke` loadgen A/B
+# (legacy connect-per-call JSON vs pooled multiplexed binary framing on
+# the same seeded workload), so the binary-vs-legacy QPS floor, the p99
+# ceiling, and the wire-bytes ceiling are judged by a plain
+# `make perf-gate`.
 DEFAULT_RECORDS = ('BENCH_r05.json', 'WIDTH_TABLE.jsonl',
                    'SERVE_MULTI.jsonl', 'SO2_SWEEP.jsonl',
                    'FLASH_AB.jsonl', 'CHAOS_SMOKE.jsonl',
                    'QUANT_AB.jsonl', 'TRAIN_CHAOS.jsonl',
                    'FLEET_CHAOS.jsonl', 'SLO_SMOKE.jsonl',
                    'V2_SWEEP.jsonl', 'ASSEMBLY_SWEEP.jsonl',
-                   'MESH_SWEEP.jsonl')
+                   'MESH_SWEEP.jsonl', 'TRANSPORT_AB.jsonl')
 
 
 # --------------------------------------------------------------------- #

@@ -149,6 +149,13 @@ def parse_args(argv=None):
     ap.add_argument('--port', type=int, default=0,
                     help='--host only: TCP port (0 = OS-assigned; the '
                          'READY line names the bound port)')
+    ap.add_argument('--transport', choices=('binary', 'legacy'),
+                    default='binary',
+                    help='fleet wire: "binary" (persistent pooled '
+                         'connections, correlation-id multiplexing, '
+                         'raw numpy array frames — the default) or '
+                         '"legacy" (connect-per-call newline-JSON '
+                         'escape hatch)')
     ap.add_argument('--checkpoint-step', type=int, default=None,
                     help='with --checkpoint: restore this step instead '
                          'of the latest (the fleet smoke starts hosts '
@@ -570,7 +577,8 @@ def serve_host(args):
         SchemaError, validate_stream,
     )
     from se3_transformer_tpu.serving import (
-        HostServer, ReplicaWorker, Router, RouterTelemetry, serve_socket,
+        HostServer, ReplicaWorker, Router, RouterTelemetry, serve_binary,
+        serve_socket,
     )
     from se3_transformer_tpu.training.guardian import PreemptionGuard
 
@@ -637,9 +645,15 @@ def serve_host(args):
                                  telemetry=telemetry,
                                  flush_every_batches=args.flush_every,
                                  on_swap=on_swap)
-        sock = serve_socket(host_server, port=args.port)
-        print(f'FLEET HOST READY host={args.host_id} port={sock.port}',
-              flush=True)
+        if args.transport == 'binary':
+            sock = serve_binary(host_server, port=args.port)
+            # every serve record this host flushes carries the wire's
+            # own counters (schema'd `transport` section)
+            telemetry.transport_source = sock.transport_stats
+        else:
+            sock = serve_socket(host_server, port=args.port)
+        print(f'FLEET HOST READY host={args.host_id} port={sock.port} '
+              f'transport={args.transport}', flush=True)
         with PreemptionGuard() as guard:
             while not guard.stop_requested:
                 time.sleep(0.05)
@@ -685,14 +699,16 @@ def host_command(host_id, *, port=0, buckets='8,16', batch_size=2,
                  replicas=1, seed=0, max_wait_ms=10.0, timeout_s=None,
                  max_retries=1, max_queue_depth=None, checkpoint=None,
                  checkpoint_step=None, metrics=None, poison_step=None,
-                 bf16=False, async_dispatch=False, cpu=True):
+                 bf16=False, async_dispatch=False, cpu=True,
+                 transport='binary'):
     """The argv for one `--host` worker process."""
     cmd = [sys.executable, os.path.abspath(__file__), '--host',
            '--host-id', str(host_id), '--port', str(port),
            '--buckets', str(buckets), '--batch-size', str(batch_size),
            '--replicas', str(replicas), '--seed', str(seed),
            '--max-wait-ms', str(max_wait_ms),
-           '--max-retries', str(max_retries)]
+           '--max-retries', str(max_retries),
+           '--transport', str(transport)]
     if cpu:
         cmd.append('--cpu')
     if bf16:
@@ -793,7 +809,9 @@ def serve_fleet(args):
     from se3_transformer_tpu.observability.schema import (
         SchemaError, validate_stream,
     )
-    from se3_transformer_tpu.serving import FleetRouter, SocketTransport
+    from se3_transformer_tpu.serving import (
+        BinaryTransport, FleetRouter, SocketTransport,
+    )
     from se3_transformer_tpu.training.guardian import PreemptionGuard
 
     buckets = tuple(int(b) for b in args.buckets.split(','))
@@ -808,7 +826,8 @@ def serve_fleet(args):
             max_queue_depth=args.max_queue_depth,
             checkpoint=args.checkpoint,
             checkpoint_step=args.checkpoint_step, bf16=args.bf16,
-            async_dispatch=args.async_dispatch, cpu=args.cpu))
+            async_dispatch=args.async_dispatch, cpu=args.cpu,
+            transport=args.transport))
     try:
         for p in procs:
             port, sink = wait_host_ready(p)
@@ -817,8 +836,12 @@ def serve_fleet(args):
         print(f'fleet up: {args.fleet} hosts on ports {ports}',
               flush=True)
 
-        transports = {i: SocketTransport('127.0.0.1', port)
-                      for i, port in enumerate(ports)}
+        if args.transport == 'binary':
+            transports = {i: BinaryTransport('127.0.0.1', port)
+                          for i, port in enumerate(ports)}
+        else:
+            transports = {i: SocketTransport('127.0.0.1', port)
+                          for i, port in enumerate(ports)}
         ok = True
         rng = np.random.RandomState(args.seed)
         lengths = request_lengths(args, buckets, buckets[-1], rng)
@@ -854,6 +877,9 @@ def serve_fleet(args):
             body = fleet.record_body(pending, label='serve_fleet')
             logger.log_record('fleet', mirror=False, **body)
         logger.close()
+        for t in transports.values():
+            if hasattr(t, 'close'):
+                t.close()    # joins the binary arm's reader threads
 
         lost = [p.request_id for p in pending if not p.done]
         # a host-side RequestRejected (oversize before the first bucket

@@ -204,6 +204,20 @@ A stream is JSONL; every record carries `kind` and `run_id`. Kinds:
                    PERF_BUDGETS.json's per-axis ceilings gate on. A
                    sweep row that cannot attribute its traffic to an
                    axis proves nothing about which axis regressed.
+  transport        fleet RPC transport A/B evidence for one loadgen
+                   run (scripts/transport_loadgen.py, banked to
+                   TRANSPORT_AB.jsonl by `make transport-smoke`): the
+                   seeded workload shape, per-arm figures for the
+                   legacy connect-per-call JSON wire and the pooled
+                   multiplexed binary wire (requests, errors, qps,
+                   p50/p99 ms, bytes per call), the load-bearing
+                   binary-vs-legacy ratios (qps / p99 / wire bytes)
+                   the committed transport budgets gate on, and the
+                   binary client's transport counters (connections
+                   opened, reconnects, peak in-flight, bytes each way,
+                   frame errors). `serve`/`fleet` records carry the
+                   same counter section under their optional
+                   `transport` key.
   summary          end-of-run cumulative record (metrics, timing,
                    nodes_steps_per_sec, loss trajectory,
                    retrace_warnings_total).
@@ -222,7 +236,8 @@ SCHEMA_VERSION = 1
 KNOWN_KINDS = ('run_meta', 'step', 'flush', 'retrace_warning', 'pipeline',
                'serve', 'tune', 'comm', 'cost', 'profile', 'so2_sweep',
                'v2_sweep', 'flash', 'fault', 'guard', 'fleet', 'quant_ab',
-               'trace', 'slo', 'assembly', 'mesh_sweep', 'summary')
+               'trace', 'slo', 'assembly', 'mesh_sweep', 'transport',
+               'summary')
 
 _REQUIRED = {
     'run_meta': ('run_id', 'schema_version', 'backend', 'code_rev', 'host'),
@@ -332,6 +347,14 @@ _REQUIRED = {
     # a tp regression would hide inside the dp gradient psum
     'mesh_sweep': ('run_id', 'dp', 'sp', 'tp', 'n', 'per_device_nodes',
                    'step_s', 'per_shard_total_gb', 'loss_finite', 'comm'),
+    # the binary-vs-legacy ratios are the load-bearing trio of the
+    # transport contract: an A/B record that cannot say the
+    # multiplexed binary arm was faster, no worse at the tail, AND
+    # lighter on the wire — on the same seeded workload — proves
+    # nothing about real fleet QPS
+    'transport': ('run_id', 'label', 'workload', 'arms',
+                  'qps_binary_vs_legacy', 'p99_binary_vs_legacy',
+                  'wire_bytes_binary_vs_legacy', 'transport'),
     'summary': ('run_id', 'steps', 'metrics', 'timing'),
 }
 
@@ -348,6 +371,14 @@ _GUARD_COUNTERS = ('trips', 'rollbacks', 'restarts', 'skipped_batches',
                    'preemptions', 'injections_total')
 _FLEET_COUNTERS = ('recoveries', 'cross_host_retries', 'request_failures',
                    'timeouts', 'rollbacks', 'lost_requests')
+# the transport counter section (serve/fleet records' optional
+# `transport` key, and the transport A/B record's required one): wire
+# accounting every arm reports with the same shape
+_TRANSPORT_COUNTERS = ('connections_opened', 'reconnects',
+                       'peak_in_flight', 'bytes_sent', 'bytes_received',
+                       'frame_errors')
+_TRANSPORT_ARM_REQUIRED = ('requests', 'errors', 'qps', 'p50_ms',
+                           'p99_ms', 'bytes_per_call')
 
 _COST_SOURCES = ('cost_analysis', 'hlo_estimate', 'unavailable')
 _COST_MEMORY_REQUIRED = ('argument_bytes', 'output_bytes', 'temp_bytes')
@@ -405,6 +436,21 @@ def _validate_model_families(val, index, where):
             not isinstance(f, str) or not f for f in val):
         _fail(index, f'{where} must be a non-empty list of non-empty '
                      f'strings (model families served), got {val!r}')
+
+
+def _validate_transport_section(val, index, where):
+    """The transport counter section (`serve`/`fleet` optional key,
+    `transport` record required key): every counter present and a
+    non-negative int — wire accounting that cannot count proves
+    nothing about the wire."""
+    if not isinstance(val, dict):
+        _fail(index, f'{where} must be an object, got '
+                     f'{type(val).__name__}')
+    for field in _TRANSPORT_COUNTERS:
+        v = val.get(field)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            _fail(index, f'{where}.{field} must be a non-negative int '
+                         f'(the transport counter contract), got {v!r}')
 
 
 def validate_record(rec: dict, index=None) -> dict:
@@ -521,6 +567,12 @@ def validate_record(rec: dict, index=None) -> dict:
                         or snap.get('state') not in _HEALTH_STATES:
                     _fail(index, f'serve.health[{rid!r}] must carry a '
                                  f'state in {_HEALTH_STATES}')
+        # host-side wire counters (serve.py attaches the socket
+        # server's transport_stats): optional but validated when
+        # present — a malformed counter section is worse than none
+        if 'transport' in rec:
+            _validate_transport_section(rec['transport'], index,
+                                        'serve.transport')
         # mergeable per-bucket latency histograms (observability.slo):
         # optional but validated when present — the fleet SLO
         # aggregation merges these by count addition, so a malformed
@@ -589,6 +641,46 @@ def validate_record(rec: dict, index=None) -> dict:
                 _fail(index, f'fleet.rollouts.events entries must '
                              f'carry canary/passed (the gate verdict '
                              f'IS the evidence), got {e!r}')
+        # fleet-side wire counters (aggregated per-host transport
+        # stats): optional but validated when present
+        if 'transport' in rec:
+            _validate_transport_section(rec['transport'], index,
+                                        'fleet.transport')
+    if kind == 'transport':
+        workload = rec['workload']
+        if not isinstance(workload, dict) \
+                or not isinstance(workload.get('requests'), int) \
+                or workload.get('requests', 0) <= 0:
+            _fail(index, f'transport.workload must carry a positive '
+                         f'int requests count (the A/B proves nothing '
+                         f'about an empty workload), got {workload!r}')
+        arms = rec['arms']
+        if not isinstance(arms, dict) or 'legacy' not in arms \
+                or 'binary' not in arms:
+            _fail(index, 'transport.arms must carry both the legacy '
+                         'and the binary arm (the A/B IS the record)')
+        for name, arm in arms.items():
+            missing = [k for k in _TRANSPORT_ARM_REQUIRED
+                       if not isinstance(arm, dict) or k not in arm]
+            if missing:
+                _fail(index, f'transport.arms[{name!r}] missing '
+                             f'{missing}')
+            for k in _TRANSPORT_ARM_REQUIRED:
+                v = arm[k]
+                if not isinstance(v, (int, float)) \
+                        or isinstance(v, bool) or v < 0:
+                    _fail(index, f'transport.arms[{name!r}].{k} must '
+                                 f'be a non-negative number, got {v!r}')
+        for field in ('qps_binary_vs_legacy', 'p99_binary_vs_legacy',
+                      'wire_bytes_binary_vs_legacy'):
+            v = rec[field]
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v <= 0:
+                _fail(index, f'transport.{field} must be a positive '
+                             f'number (the ratio the budgets gate on), '
+                             f'got {v!r}')
+        _validate_transport_section(rec['transport'], index,
+                                    'transport.transport')
     if kind == 'guard':
         for field in _GUARD_COUNTERS + ('step',):
             val = rec[field]

@@ -35,7 +35,10 @@ spans chips (TP/FSDP) while DP replicas multiply throughput.
 
   * `transport` + `fleet` — the CROSS-HOST tier (ROADMAP item 5): a
     minimal pluggable RPC transport (in-process `LocalTransport` for
-    tests, newline-JSON `SocketTransport` for real processes),
+    tests; `BinaryTransport`/`BinaryServer` — persistent pooled
+    connections, correlation-id multiplexing, length-prefixed binary
+    frames with raw numpy array segments — as the production wire;
+    newline-JSON `SocketTransport` kept as the legacy escape hatch),
     `HostServer` exposing one host's router behind five JSON-safe
     methods, and `FleetRouter` — the PR 12 breaker lifted to HOST
     granularity (RPC outcomes + heartbeat staleness drive it, half-open
@@ -54,5 +57,6 @@ from .replica import ContinuousBatcher, ReplicaWorker  # noqa: F401
 from .router import Router  # noqa: F401
 from .telemetry import RouterTelemetry  # noqa: F401
 from .transport import (  # noqa: F401
-    LocalTransport, SocketTransport, TransportError, serve_socket,
+    BinaryServer, BinaryTransport, LocalTransport, SocketTransport,
+    TransportError, serve_binary, serve_socket,
 )

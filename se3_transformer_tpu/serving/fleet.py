@@ -78,17 +78,27 @@ _HOST_FAILURE_CODES = ('retries_exhausted', 'internal', 'host_timeout')
 
 
 class _Call:
-    __slots__ = ('method', 'payload', 'event', 'response')
+    __slots__ = ('method', 'payload', 'event', 'response', 'on_done')
 
-    def __init__(self, method: str, payload: dict):
+    def __init__(self, method: str, payload: dict,
+                 on_done: Optional[Callable] = None):
         self.method = method
         self.payload = payload
         self.event = threading.Event()
         self.response: Optional[dict] = None
+        self.on_done = on_done
 
     def respond(self, response: dict):
         self.response = response
         self.event.set()
+        if self.on_done is not None:
+            try:
+                self.on_done(response)
+            except Exception as e:  # a buggy completion callback must
+                #                     not take the serve loop with it
+                warnings.warn(f'{self.method!r} completion callback '
+                              f'raised {type(e).__name__}: {e}',
+                              RuntimeWarning)
 
 
 class HostServer:
@@ -182,6 +192,39 @@ class HostServer:
                 message=f'{method!r} timed out after {wait:.1f}s inside '
                         f'host {self.host_id}\'s serve loop'))
         return call.response
+
+    def handle_async(self, method: str, payload: Optional[dict] = None,
+                     reply: Optional[Callable] = None,
+                     timeout_s: Optional[float] = None) -> None:
+        """Transport entry, callback form (any thread): enqueue onto
+        the serve loop and return immediately — `reply(response)`
+        fires exactly once when the response is ready (from the serve
+        loop thread for watched infers; the binary frame-pump server
+        hands it straight to its writer pool, so the loop never blocks
+        on a slow client socket). The binary server rides this so a
+        slow infer never parks one of its pump threads and in-flight
+        depth stays bounded by admission control, not the pool size.
+
+        Unlike the blocking `handle`, a WEDGED serve loop here answers
+        nothing — the caller's own transport deadline raises
+        `TransportError`, which the fleet counts as the same host
+        failure `host_timeout` maps to."""
+        if method not in self.METHODS:
+            reply(dict(ok=False, error=dict(
+                code='unknown_method',
+                message=f'{method!r} not in {self.METHODS}')))
+            return
+        with self._calls_lock:
+            self.calls[method] = self.calls.get(method, 0) + 1
+        if method == 'ping':
+            # same fast path off the serve loop as `handle`: probes
+            # answer PROCESS liveness even mid-dispatch
+            now = self.clock()
+            reply(dict(ok=True, host=self.host_id, t=round(now, 4),
+                       uptime_s=round(now - self.started_at, 3)))
+            return
+        self._inbox.put(_Call(method, dict(payload or {}),
+                              on_done=reply))
 
     def stop(self, drain: bool = True):
         """End the serve loop (then drain the router by default, so
@@ -297,8 +340,13 @@ class HostServer:
 
     def _infer_response(self, p: PendingResult) -> dict:
         if p.ok:
+            # the result stays a numpy array — LocalTransport hands the
+            # buffer through untouched and the binary framing ships it
+            # raw; only the legacy JSON wire degrades it to lists (its
+            # server's json.dumps default= hook), so the old tolist()
+            # copy tax is paid exactly where a text wire demands it
             resp = dict(ok=True,
-                        result=np.asarray(p.result).tolist(),
+                        result=np.asarray(p.result),
                         latency_ms=round((p.latency_s or 0.0) * 1e3, 3))
         else:
             err = p.error
@@ -528,22 +576,73 @@ class FleetRouter:
                 if isinstance(v, (int, float))]
         return (depth, rank, max(p99s) if p99s else 0.0, h.id)
 
-    def _pick_host(self, exclude: Optional[int] = None) -> _HostHandle:
-        """Least-loaded over (fleet in-flight + scraped depth), healthy
-        before degraded, scraped p99 tie-break. Quarantined hosts and
-        `exclude` (the host a retry just failed on) leave the pool —
-        unless `host_exclusion` was nulled (the weaken arm), in which
-        case placement is load-only and the chaos gate must catch the
-        consequences. All-quarantined degrades to best-effort over
-        everything (serving through a sick host beats black-holing)."""
+    def _host_capable(self, h: _HostHandle, length: Optional[int],
+                      family: Optional[str]) -> bool:
+        """Can this host serve a request of `length` tokens for model
+        `family`, judged on its last scraped stats (bucket set + model
+        families)? A host that has never been scraped counts as
+        capable — ignorance must not black-hole traffic before the
+        first heartbeat lands."""
+        st = h.stats
+        if not st:
+            return True
+        if length is not None and st.get('buckets'):
+            if fit_bucket(tuple(int(b) for b in st['buckets']),
+                          int(length)) is None:
+                return False
+        if family is not None and st.get('model_families'):
+            if family not in st['model_families']:
+                return False
+        return True
+
+    def _pick_host(self, exclude: Optional[int] = None,
+                   length: Optional[int] = None,
+                   family: Optional[str] = None) -> _HostHandle:
+        """CAPABILITY filter first, then least-loaded over (fleet
+        in-flight + scraped depth), healthy before degraded, scraped
+        p99 tie-break. The capability filter (scraped bucket sets +
+        model families) means a request sized for a big bucket never
+        lands on a host that lacks it — in a heterogeneous fleet the
+        incapable hosts simply leave the pool; if NO host is capable
+        the request rejects structurally, naming per-host capabilities
+        and which hosts are capable on each axis. Quarantined hosts
+        and `exclude` (the host a retry just failed on) leave the pool
+        — unless `host_exclusion` was nulled (the weaken arm), in
+        which case placement is load-only and the chaos gate must
+        catch the consequences. All-quarantined degrades to
+        best-effort over the CAPABLE hosts (serving through a sick
+        host beats black-holing; serving through an incapable one is
+        just a slower reject)."""
         hosts = list(self.hosts.values())
-        pool = hosts
+        pool = [h for h in hosts
+                if self._host_capable(h, length, family)]
+        if not pool:
+            by_len = [h.id for h in hosts
+                      if self._host_capable(h, length, None)]
+            by_fam = [h.id for h in hosts
+                      if self._host_capable(h, None, family)]
+            caps = {str(h.id): dict(
+                        buckets=list(h.stats.get('buckets') or []),
+                        model_families=list(
+                            h.stats.get('model_families') or []))
+                    for h in hosts}
+            raise RequestRejected(
+                'no_capable_host',
+                f'no host serves length={length} '
+                f'model_family={family!r}: capable by length '
+                f'{by_len}, by family {by_fam}, per-host '
+                f'capabilities {caps}',
+                length=length, model_family=family,
+                capable_by_length=by_len, capable_by_family=by_fam,
+                host_capabilities=caps)
+        capable = pool
         if self.host_exclusion:
-            pool = [h for h in hosts
+            pool = [h for h in capable
                     if h.id != exclude
                     and self.health.state(h.id) != QUARANTINED]
             if not pool:
-                pool = [h for h in hosts if h.id != exclude] or hosts
+                pool = [h for h in capable
+                        if h.id != exclude] or capable
         return min(pool, key=self._score)
 
     # ------------------------------------------------------------------ #
@@ -551,12 +650,18 @@ class FleetRouter:
     # ------------------------------------------------------------------ #
     def submit(self, tokens, coords,
                timeout_s: Optional[float] = None,
-               pin_host: Optional[int] = None) -> PendingResult:
+               pin_host: Optional[int] = None,
+               model_family: Optional[str] = None) -> PendingResult:
         """Admit one request; a pool thread dispatches it (cross-host
         retries included) and resolves the returned PendingResult.
         Oversize requests reject at the door once any host has reported
         its buckets (before that, the host's own rejection resolves the
-        pending structurally — either way, never silence).
+        pending structurally — either way, never silence). The door
+        gate uses the UNION of scraped bucket sets — in a
+        heterogeneous fleet a request only rejects here when NO host
+        could ever serve it; per-host placement then routes it to the
+        hosts that actually have the bucket (and, when `model_family`
+        is given, serve that family).
 
         `pin_host` pins the dispatch to ONE host, single-attempt (the
         rollout's canary probes ride this: a redispatch to a healthy
@@ -591,7 +696,8 @@ class FleetRouter:
                                      pinned=pin_host)
             pending.trace = dict(ctx=tid, root=root)
         self._track(self._executor.submit(
-            self._dispatch, pending, tokens, coords, pin_host))
+            self._dispatch, pending, tokens, coords, pin_host,
+            model_family))
         return pending
 
     def _track(self, future: Future):
@@ -601,7 +707,8 @@ class FleetRouter:
             self._futures.append(future)
 
     def _dispatch(self, pending: PendingResult, tokens, coords,
-                  pin_host: Optional[int] = None):
+                  pin_host: Optional[int] = None,
+                  model_family: Optional[str] = None):
         """Worker-pool body: pick -> RPC -> redispatch or resolve.
         NEVER raises — every exit resolves the pending (the zero-lost
         contract is this function terminating structurally)."""
@@ -619,8 +726,19 @@ class FleetRouter:
                         now - pending.submitted_at, timeout_s,
                         attempts=pending.attempts))
                     return
-                host = (self.hosts[pin_host] if pin_host is not None
-                        else self._pick_host(exclude=exclude))
+                try:
+                    host = (self.hosts[pin_host]
+                            if pin_host is not None
+                            else self._pick_host(
+                                exclude=exclude,
+                                length=pending.length,
+                                family=model_family))
+                except RequestRejected as e:
+                    # capability reject: no host in the fleet serves
+                    # this size/family — structured, names the capable
+                    # hosts per axis, retrying cannot improve it
+                    self._fail_request(pending, e)
+                    return
                 outcome, err = self._call_infer(host, pending,
                                                 tokens, coords)
                 if outcome in ('answered', 'resolved'):
@@ -666,8 +784,11 @@ class FleetRouter:
         the request got a structured verdict (deadline / reject) that
         redispatching cannot improve."""
         now = self.clock()
-        payload = dict(tokens=np.asarray(tokens).tolist(),
-                       coords=np.asarray(coords).tolist())
+        # arrays ride the payload as-is: zero-copy through
+        # LocalTransport, raw framed segments through BinaryTransport;
+        # the legacy JSON arm degrades them to lists at ITS wire
+        payload = dict(tokens=np.asarray(tokens),
+                       coords=np.asarray(coords))
         rpc_timeout = None
         if pending.deadline is not None:
             remaining = max(0.0, pending.deadline - now)
@@ -804,9 +925,14 @@ class FleetRouter:
                 self.slo.fold(h.id, h.stats)
             with self._lock:
                 self.heartbeats_ok += 1
-                if self.buckets is None and h.stats.get('buckets'):
-                    self.buckets = tuple(int(b)
-                                         for b in h.stats['buckets'])
+                if h.stats.get('buckets'):
+                    # fleet-level buckets = UNION over scraped hosts:
+                    # the door-level oversize gate only rejects what NO
+                    # host could serve; per-host capability filtering
+                    # in _pick_host handles heterogeneity
+                    self.buckets = tuple(sorted(
+                        {int(b) for b in h.stats['buckets']}
+                        | set(self.buckets or ())))
         else:
             with self._lock:
                 self.heartbeats_failed += 1
@@ -1042,6 +1168,25 @@ class FleetRouter:
         (None limits lost accounting to what the fleet can see, i.e.
         0 — pass the real list)."""
         pending = list(pending or [])
+        # per-host transport counters (only transports that expose
+        # them — BinaryTransport and SocketTransport do, the wire-free
+        # LocalTransport has nothing to count), aggregated fleet-wide:
+        # sums for the monotonic counters, max for the peak gauge
+        tstats = {}
+        for hid, h in sorted(self.hosts.items()):
+            snap = getattr(h.transport, 'transport_stats', None)
+            if callable(snap):
+                tstats[str(hid)] = snap()
+        transport_section = None
+        if tstats:
+            transport_section = {
+                k: sum(s.get(k, 0) for s in tstats.values())
+                for k in ('connections_opened', 'reconnects',
+                          'bytes_sent', 'bytes_received',
+                          'frame_errors')}
+            transport_section['peak_in_flight'] = max(
+                s.get('peak_in_flight', 0) for s in tstats.values())
+            transport_section['by_host'] = tstats
         hsnap = self.health.snapshot()
         hosts = {}
         for hid, h in sorted(self.hosts.items()):
@@ -1084,4 +1229,6 @@ class FleetRouter:
                     if p.done and p.error is not None),
                 lost_requests=sum(1 for p in pending if not p.done),
             )
+        if transport_section is not None:
+            body['transport'] = transport_section
         return body

@@ -19,7 +19,7 @@ skew is visible separately via `replicas[i].depth` / `.served`.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 from ..inference.admission import AdmissionController
 from ..inference.stats import agg_stats
@@ -55,6 +55,10 @@ class RouterTelemetry(ServeTelemetryBase):
         super().__init__(router.workers[0].engine.timer, admission,
                          logger, watchdog)
         self.router = router
+        # optional host-side transport counters (serve.py attaches the
+        # socket server's `transport_stats` here): when set, every
+        # serve record carries a schema-validated `transport` section
+        self.transport_source: Optional[Callable[[], dict]] = None
         for w in router.workers:
             for key, executable in w.engine.executables.items():
                 self.watchdog.track(f'r{w.id}_bucket_{key[0]}', executable)
@@ -160,6 +164,8 @@ class RouterTelemetry(ServeTelemetryBase):
         # from the SAME base helper the single-engine emitter uses —
         # the two serve-record shapes cannot drift
         fields.update(self._latency_sections())
+        if self.transport_source is not None:
+            fields['transport'] = dict(self.transport_source())
         return self._emit('serve', fields)
 
     def close(self) -> dict:

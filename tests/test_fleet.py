@@ -256,6 +256,78 @@ def test_fleet_routes_and_answers_across_hosts():
     _shutdown(fleet, servers)
 
 
+def test_capability_aware_placement_on_heterogeneous_fleet():
+    """A length-12 request in a (4,8) + (4,8,16) fleet must land ONLY
+    on the host whose scraped bucket set can actually serve it — and a
+    request no host can serve resolves as a structured reject that
+    NAMES the capable hosts per axis."""
+    s0, e0 = _host(0)                       # buckets (4, 8)
+    s1, e1 = _host(1, buckets=(4, 8, 16))
+    transports = {0: LocalTransport(s0), 1: LocalTransport(s1)}
+    fleet = FleetRouter(transports, max_retries=2,
+                        default_timeout_s=10.0,
+                        health=HealthConfig(quarantine_after=3,
+                                            recover_after=2,
+                                            probe_backoff_s=0.02,
+                                            probe_backoff_max_s=0.2),
+                        heartbeat_every_s=0.01)
+    try:
+        # wait until BOTH hosts' capabilities are scraped — before the
+        # first heartbeat an unscraped host counts as capable by design
+        t0 = time.monotonic()
+        while (any(not h.stats for h in fleet.hosts.values())
+               and time.monotonic() - t0 < 5):
+            fleet.pump()
+            time.sleep(0.005)
+        assert all(h.stats for h in fleet.hosts.values())
+        # the door gate sees the UNION of bucket sets
+        assert fleet.buckets == (4, 8, 16)
+
+        rng = np.random.RandomState(0)
+        pending = [fleet.submit(*_request(rng, 12)) for _ in range(6)]
+        fleet.drain()
+        assert all(p.ok for p in pending)
+        assert sum(e1.rows_served.values()) >= 6     # the capable host
+        assert sum(e0.rows_served.values()) == 0     # never misplaced
+
+        # no host serves this family: structured reject, not silence —
+        # and the detail names who IS capable on each axis
+        p = fleet.submit(*_request(rng, 3), model_family='se3_v9')
+        fleet.drain()
+        assert p.done and not p.ok
+        assert isinstance(p.error, RequestRejected)
+        assert p.error.code == 'no_capable_host'
+        assert sorted(p.error.detail['capable_by_length']) == [0, 1]
+        assert p.error.detail['capable_by_family'] == []
+        assert set(p.error.detail['host_capabilities']) == {'0', '1'}
+    finally:
+        _shutdown(fleet, [s0, s1])
+
+
+def test_local_transport_passes_numpy_through_bit_exact():
+    """The in-process copy-tax satellite: tokens/coords submitted as
+    numpy arrays survive LocalTransport + HostServer.handle with NO
+    list round-trip, and the result matches the engine's float32
+    output bit for bit (what the old tolist() wire degraded)."""
+    server, engine = _host(0)
+    t = LocalTransport(server)
+    rng = np.random.RandomState(3)
+    try:
+        tokens, coords = _request(rng, 7)
+        res = t.call('infer', dict(tokens=tokens, coords=coords,
+                                   timeout_s=5.0), timeout_s=10.0)
+        assert res['ok']
+        out = res['result']
+        assert isinstance(out, np.ndarray)       # never listified
+        assert out.dtype == np.float32
+        expected = engine.run(
+            8, tokens[None], coords[None],
+            np.ones((1, len(tokens)), bool))[0][:len(tokens)]
+        assert np.array_equal(out, expected)     # bit parity
+    finally:
+        server.stop()
+
+
 def test_dead_host_quarantines_redispatch_answers_probe_recovers():
     """The SIGKILL arc in miniature: every request still answers via
     cross-host redispatch, the dead host's breaker walks to

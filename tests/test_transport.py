@@ -1,0 +1,276 @@
+"""Binary transport tier tests (se3_transformer_tpu.serving.transport):
+the length-prefixed frame codec (raw numpy segments, zero tolist on
+the array path), the pooled multiplexed client vs the frame-pump
+server, correlation ids under a concurrent hammer, mid-stream
+host-death reconnect, the seeded FaultInjector contract on the new
+framing, and the schema'd `transport` record kind."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from se3_transformer_tpu.faults import FaultInjector
+from se3_transformer_tpu.observability.schema import (
+    SchemaError, validate_record,
+)
+from se3_transformer_tpu.serving.transport import (
+    BinaryServer, BinaryTransport, FrameError, TransportError,
+    pack_frame, unpack_frame,
+)
+
+
+def _join_frame(bufs):
+    """Client-side frame bytes -> (env_bytes, body) the way the wire
+    delivers them (header stripped)."""
+    raw = b''.join(bytes(memoryview(b)) for b in bufs)
+    import struct
+    magic, env_len, body_len = struct.unpack_from('>4sII', raw)
+    assert magic == b'SE3B'
+    env = raw[12:12 + env_len]
+    body = memoryview(raw)[12 + env_len:12 + env_len + body_len]
+    return env, body
+
+
+def _handler(method, payload=None, timeout_s=None, log=None):
+    if log is not None:
+        log.append(method)
+    payload = payload or {}
+    if method == 'ping':
+        return dict(ok=True, t=time.monotonic())
+    if method == 'echo':
+        return dict(ok=True, echoed=payload)
+    if method == 'double':
+        if payload.get('delay'):
+            time.sleep(payload['delay'])
+        return dict(ok=True, tag=payload['tag'],
+                    result=np.asarray(payload['x']) * 2)
+    if method == 'sleepy':
+        time.sleep(payload['s'])
+        return dict(ok=True)
+    raise RuntimeError(f'unhandled {method!r}')
+
+
+# --------------------------------------------------------------------- #
+# the codec
+# --------------------------------------------------------------------- #
+def test_frame_codec_round_trip_preserves_dtypes_and_nesting():
+    msg = dict(
+        id=7, method='infer',
+        payload=dict(tokens=np.arange(12, dtype=np.int32),
+                     coords=np.random.RandomState(0).normal(
+                         size=(12, 3)).astype(np.float32),
+                     mask=np.array([[True, False], [True, True]]),
+                     wide=np.arange(4, dtype=np.int64),
+                     timeout_s=2.5, trace=dict(origin='t', hops=[1, 2])))
+    env, body = _join_frame(pack_frame(msg))
+    out = unpack_frame(env, body)
+    assert out['id'] == 7 and out['method'] == 'infer'
+    p, q = msg['payload'], out['payload']
+    for key in ('tokens', 'coords', 'mask', 'wide'):
+        assert q[key].dtype == p[key].dtype, key
+        assert np.array_equal(q[key], p[key]), key
+    assert q['timeout_s'] == 2.5
+    assert q['trace'] == dict(origin='t', hops=[1, 2])
+    # arrays ride as raw segments, not JSON text
+    assert b'tokens' in env and b'[0, 1' not in env
+
+
+def test_frame_codec_rejects_corruption():
+    with pytest.raises(FrameError):
+        unpack_frame(b'not json at all', memoryview(b''))
+    # manifest/body length mismatch: a truncated array segment can
+    # never be silently zero-filled
+    env, body = _join_frame(pack_frame(dict(
+        id=1, method='m', payload=dict(x=np.arange(8, dtype=np.int64)))))
+    with pytest.raises(FrameError):
+        unpack_frame(env, body[:-8])
+
+
+# --------------------------------------------------------------------- #
+# client <-> server round trip
+# --------------------------------------------------------------------- #
+def test_binary_round_trip_arrays_bit_exact():
+    srv = BinaryServer(_handler, port=0)
+    t = BinaryTransport('127.0.0.1', srv.port, label='t0')
+    try:
+        x = np.random.RandomState(1).normal(size=(9, 3)).astype(
+            np.float32)
+        res = t.call('echo', dict(x=x, n=3), timeout_s=5.0)
+        assert res['ok']
+        assert res['echoed']['x'].dtype == np.float32
+        assert np.array_equal(res['echoed']['x'], x)   # bit parity
+        assert res['echoed']['n'] == 3
+        assert t.call('ping', timeout_s=5.0)['ok']
+        cstats, sstats = t.transport_stats(), srv.transport_stats()
+        assert cstats['bytes_sent'] > 0 and cstats['bytes_received'] > 0
+        assert sstats['bytes_received'] == cstats['bytes_sent']
+        assert cstats['frame_errors'] == 0
+        assert sstats['frame_errors'] == 0
+    finally:
+        t.close()
+        srv.close()
+
+
+def test_handler_crash_is_structured_not_a_torn_wire():
+    srv = BinaryServer(_handler, port=0)
+    t = BinaryTransport('127.0.0.1', srv.port, label='t0')
+    try:
+        res = t.call('nope', timeout_s=5.0)
+        assert not res['ok'] and res['error']['code'] == 'internal'
+        # the connection survived the crash — next call reuses it
+        assert t.call('ping', timeout_s=5.0)['ok']
+        assert t.transport_stats()['reconnects'] == 0
+    finally:
+        t.close()
+        srv.close()
+
+
+# --------------------------------------------------------------------- #
+# multiplexing: correlation ids never cross
+# --------------------------------------------------------------------- #
+def test_multiplex_hammer_responses_match_requests():
+    """8 client threads x 4 calls each over a 2-connection pool, with
+    staggered server-side delays so responses complete OUT of request
+    order on every connection — each response must still carry its own
+    request's tag and payload."""
+    srv = BinaryServer(_handler, port=0, pumps=4)
+    t = BinaryTransport('127.0.0.1', srv.port, label='mux',
+                        pool_size=2)
+    failures = []
+
+    def client(tid):
+        for k in range(4):
+            i = tid * 4 + k
+            x = np.full(16 + i, i, dtype=np.int32)
+            try:
+                res = t.call('double',
+                             dict(tag=i, x=x, delay=(i % 5) * 0.004),
+                             timeout_s=10.0)
+                if not res['ok'] or res['tag'] != i \
+                        or not np.array_equal(res['result'], x * 2):
+                    failures.append(f'req {i} got {res.get("tag")}')
+            except Exception as e:  # noqa: BLE001
+                failures.append(f'req {i}: {e}')
+
+    try:
+        threads = [threading.Thread(target=client, args=(n,))
+                   for n in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not failures, failures[:5]
+        stats = t.transport_stats()
+        assert stats['connections_opened'] == 2     # the pool persisted
+        assert stats['reconnects'] == 0
+        assert stats['peak_in_flight'] >= 2          # genuinely muxed
+        assert stats['frame_errors'] == 0
+    finally:
+        t.close()
+        srv.close()
+
+
+# --------------------------------------------------------------------- #
+# host death: in-flight fails loudly, next call reconnects
+# --------------------------------------------------------------------- #
+def test_midstream_server_death_fails_inflight_then_reconnects():
+    srv = BinaryServer(_handler, port=0)
+    port = srv.port
+    t = BinaryTransport('127.0.0.1', port, label='t0', pool_size=1)
+    try:
+        assert t.call('ping', timeout_s=5.0)['ok']
+        errs = []
+
+        def inflight():
+            try:
+                t.call('sleepy', dict(s=30.0), timeout_s=30.0)
+            except TransportError as e:
+                errs.append(e)
+
+        th = threading.Thread(target=inflight)
+        th.start()
+        time.sleep(0.2)              # the call is on the wire
+        srv.close()                  # SIGKILL stand-in: sockets torn
+        th.join(timeout=10.0)
+        assert not th.is_alive()
+        assert len(errs) == 1        # in-flight failed LOUDLY, fast
+        # host restarts on the same port; the same transport object
+        # recovers without any external reset
+        srv = BinaryServer(_handler, port=port)
+        res = t.call('ping', timeout_s=5.0)
+        assert res['ok']
+        stats = t.transport_stats()
+        assert stats['reconnects'] >= 1
+        assert stats['connections_opened'] >= 2
+    finally:
+        t.close()
+        srv.close()
+
+
+# --------------------------------------------------------------------- #
+# the seeded fault contract survives the framing swap
+# --------------------------------------------------------------------- #
+def test_fault_injector_fires_on_binary_framing():
+    log = []
+    srv = BinaryServer(
+        lambda m, p=None, timeout_s=None: _handler(m, p, log=log),
+        port=0)
+    inj = FaultInjector(seed=0)
+    inj.plan('transport', 'latency', every=1, latency_s=0.08,
+             match=dict(method='ping'))
+    inj.plan('transport', 'exception', every=1,
+             match=dict(method='echo'))
+    inj.plan('transport', 'drop', every=1, match=dict(method='double'))
+    t = BinaryTransport('127.0.0.1', srv.port, label='t0',
+                        fault_injector=inj)
+    try:
+        t0 = time.perf_counter()
+        assert t.call('ping', timeout_s=5.0)['ok']
+        assert time.perf_counter() - t0 >= 0.08   # latency slept
+        with pytest.raises(TransportError):
+            t.call('echo', dict(x=1), timeout_s=5.0)
+        before = list(log)
+        with pytest.raises(TransportError, match='dropped'):
+            t.call('double', dict(tag=0, x=np.ones(3)), timeout_s=5.0)
+        time.sleep(0.1)
+        assert log == before      # the drop was never SENT
+        assert len(inj.injected) == 3
+    finally:
+        t.close()
+        srv.close()
+
+
+# --------------------------------------------------------------------- #
+# the `transport` record kind
+# --------------------------------------------------------------------- #
+def _transport_record():
+    arm = dict(requests=240, errors=0, qps=900.0, p50_ms=4.0,
+               p99_ms=30.0, bytes_per_call=20000)
+    return dict(
+        kind='transport', run_id='t', label='loadgen,test',
+        workload=dict(requests=240, concurrency=8, length=768, seed=0),
+        arms=dict(legacy=dict(arm, qps=150.0, p99_ms=90.0,
+                              bytes_per_call=63000),
+                  binary=arm),
+        transport=dict(connections_opened=2, reconnects=0,
+                       peak_in_flight=8, bytes_sent=10, bytes_received=9,
+                       frame_errors=0),
+        qps_binary_vs_legacy=6.0, p99_binary_vs_legacy=0.33,
+        wire_bytes_binary_vs_legacy=0.32)
+
+
+def test_transport_record_schema_valid_and_guarded():
+    validate_record(_transport_record())
+    for mutate in (
+            lambda r: r.pop('qps_binary_vs_legacy'),
+            lambda r: r['arms'].pop('binary'),
+            lambda r: r['arms']['legacy'].pop('p99_ms'),
+            lambda r: r['transport'].pop('frame_errors'),
+            lambda r: r['transport'].update(reconnects=-1),
+            lambda r: r['workload'].update(requests=0),
+    ):
+        broken = _transport_record()
+        mutate(broken)
+        with pytest.raises(SchemaError):
+            validate_record(broken)
